@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the metric of record.
+
+Metric (BASELINE.json:2): ResNet50/ImageNet images/sec/chip, measured on the
+headline single-chip synthetic config (config 1 scaled to a throughput-class
+batch), bfloat16, after compile/warmup exclusion — the same protocol the
+reference's harness used for its images/sec tables (SURVEY.md §3.4).
+
+``vs_baseline``: BASELINE.json captured no published reference numbers
+("published": {}), so the denominator is the north-star target expressed
+per-chip: 8xV100 ResNet50 ImageNet aggregate on a v5e-8, i.e. one V100's
+mixed-precision throughput per chip. We pin that at 1450 images/sec/chip
+(NVIDIA's commonly-published V100 ResNet50 AMP figure); vs_baseline > 1.0
+means beating the target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+V100_AMP_RESNET50_IMAGES_PER_SEC = 1450.0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup-steps", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    n_dev = jax.device_count()
+    cfg = TrainConfig(
+        model=args.model,
+        global_batch_size=args.batch_size * n_dev,
+        dtype="bfloat16",
+        log_every=10**9,  # silent; bench prints exactly one line
+        parallel=ParallelConfig(data=n_dev),
+        data=DataConfig(synthetic=True))
+
+    summary = loop.run(
+        cfg, total_steps=args.warmup_steps + args.steps,
+        warmup_steps=args.warmup_steps,
+        logger=MetricLogger(enabled=False))
+
+    value = summary["examples_per_sec_per_chip"]
+    print(json.dumps({
+        "metric": f"{args.model}_imagenet_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / V100_AMP_RESNET50_IMAGES_PER_SEC, 4),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""TPU-native distributed deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+``microsoft/DistributedDeepLearning`` (Horovod+NCCL multi-GPU training of
+ImageNet CNNs and BERT), built TPU-first:
+
+- data parallelism via ``shard_map`` + ``psum`` over an ICI device mesh
+  (replacing ``hvd.DistributedOptimizer`` / NCCL ring-allreduce);
+- tensor / sequence parallelism via ``jit`` + ``NamedSharding`` rules
+  (XLA emits the collectives — there is no userland ring);
+- input pipelines with device-side prefetch (replacing CUDA/DALI loaders);
+- a pod-slice launcher (replacing mpirun / Batch-AI job submission).
+
+Reference provenance: the reference checkout at /root/reference was empty at
+build time (see SURVEY.md header); the capability contract is BASELINE.json
+(north star + 5 acceptance configs), cited throughout as BASELINE.json:N.
+"""
+
+__version__ = "0.1.0"
+
+from distributeddeeplearning_tpu.config import (  # noqa: F401
+    DataConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    TrainConfig,
+)

@@ -1,0 +1,170 @@
+"""Typed configuration for trainers, data, optimizers, and parallelism.
+
+Replaces the reference's dotenv + Makefile variables + per-script argparse
+flags (SURVEY.md §2 #12) with one dataclass tree; ``train.py`` exposes the
+same CLI surface (``--backend``, model/batch/epoch flags) per BASELINE.json:5
+("train.py entrypoints ... run unchanged from the CLI with --backend=tpu").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Device-mesh layout.
+
+    Axis sizes multiply to the total device count. ``data`` is the
+    Horovod-equivalent allreduce axis (BASELINE.json:5: "jax.pmap/pjit
+    emitting XLA psum over ICI"); ``model``/``seq`` enable tensor and
+    sequence/context parallelism for transformer workloads.
+    """
+
+    data: int = 1       # dp: batch sharding, grad psum
+    fsdp: int = 1       # parameter sharding along the data axis family
+    model: int = 1      # tp: weight-column/row sharding
+    seq: int = 1        # sp/cp: sequence-dim sharding (ring attention)
+    expert: int = 1     # ep: MoE expert sharding (reserved)
+    pipeline: int = 1   # pp: pipeline stages (reserved)
+
+    @property
+    def num_devices(self) -> int:
+        return (self.data * self.fsdp * self.model * self.seq
+                * self.expert * self.pipeline)
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "model": self.model,
+            "seq": self.seq,
+            "expert": self.expert,
+            "pipeline": self.pipeline,
+        }
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Input pipeline settings (SURVEY.md §2 #5/#6)."""
+
+    dataset: str = "imagenet"
+    data_dir: Optional[str] = None
+    synthetic: bool = True        # config 1: "synthetic data" BASELINE.json:7
+    image_size: int = 224
+    num_classes: int = 1000
+    shuffle_buffer: int = 16384
+    prefetch_depth: int = 2       # device-side double buffering
+    # BERT-style sequence workloads:
+    seq_len: int = 128
+    vocab_size: int = 30522
+    mlm_mask_prob: float = 0.15
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Optimizer + schedule (SGD-momentum default; LARS for config 5)."""
+
+    name: str = "sgd"             # sgd | lars | adamw
+    learning_rate: float = 0.1    # for the reference batch size (256)
+    reference_batch: int = 256    # linear-scaling rule base
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    warmup_epochs: float = 5.0
+    schedule: str = "warmup_cosine"  # warmup_cosine | constant | linear
+    label_smoothing: float = 0.1
+    grad_clip_norm: Optional[float] = None
+    # LARS (config 5, BASELINE.json:11):
+    trust_coefficient: float = 0.001
+    # AdamW (BERT):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Top-level run description — one per acceptance config."""
+
+    model: str = "resnet50"
+    backend: str = "tpu"          # tpu | cpu (BASELINE.json:5)
+    global_batch_size: int = 32   # config 1 default (BASELINE.json:7)
+    num_epochs: float = 90.0
+    steps_per_epoch: Optional[int] = None  # derived from dataset if None
+    total_steps: Optional[int] = None      # overrides epochs when set
+    dtype: str = "bfloat16"       # compute dtype; params stay f32
+    seed: int = 0
+    log_every: int = 100
+    eval_every_epochs: float = 1.0
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 5000
+    resume: bool = True
+    profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+
+    @property
+    def per_device_batch(self) -> int:
+        shards = self.parallel.data * self.parallel.fsdp
+        if self.global_batch_size % max(shards, 1):
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} not divisible by "
+                f"data-parallel shards={shards}")
+        return self.global_batch_size // max(shards, 1)
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance-config presets (BASELINE.json:6-12). Keyed by the names used by
+# train.py --config=... ; each is a TrainConfig factory so tests can shrink
+# them without mutation hazards.
+# ---------------------------------------------------------------------------
+
+def preset(name: str) -> TrainConfig:
+    """Return one of the five acceptance configurations by name."""
+    if name == "resnet50_synthetic":      # config 1
+        return TrainConfig(
+            model="resnet50", global_batch_size=32,
+            data=DataConfig(synthetic=True))
+    if name == "resnet50_dp":             # config 2
+        return TrainConfig(
+            model="resnet50", global_batch_size=256,
+            parallel=ParallelConfig(data=8),
+            data=DataConfig(synthetic=False))
+    if name == "resnet152_dp":            # config 3
+        return TrainConfig(
+            model="resnet152", global_batch_size=256,
+            parallel=ParallelConfig(data=8))
+    if name == "densenet121_dp":          # config 3
+        return TrainConfig(
+            model="densenet121", global_batch_size=256,
+            parallel=ParallelConfig(data=8))
+    if name == "bert_base_mlm":           # config 4
+        return TrainConfig(
+            model="bert_base", global_batch_size=256,
+            parallel=ParallelConfig(data=8),
+            data=DataConfig(dataset="mlm", seq_len=128),
+            optimizer=OptimizerConfig(
+                name="adamw", learning_rate=1e-4, weight_decay=0.01,
+                schedule="linear", warmup_epochs=0.0, label_smoothing=0.0))
+    if name == "resnet50_lars_32k":       # config 5
+        return TrainConfig(
+            model="resnet50", global_batch_size=32768, dtype="bfloat16",
+            parallel=ParallelConfig(data=256),
+            optimizer=OptimizerConfig(
+                # peak LR 29.0 AT batch 32k (LARS paper recipe): pin
+                # reference_batch so the linear-scaling rule is identity here.
+                name="lars", learning_rate=29.0, reference_batch=32768,
+                momentum=0.9, weight_decay=1e-4, warmup_epochs=5.0,
+                schedule="warmup_poly", label_smoothing=0.1))
+    raise KeyError(f"unknown preset {name!r}; see BASELINE.json configs")
+
+
+PRESETS = (
+    "resnet50_synthetic", "resnet50_dp", "resnet152_dp", "densenet121_dp",
+    "bert_base_mlm", "resnet50_lars_32k",
+)

@@ -1,0 +1,10 @@
+"""Input pipelines: the TPU-native replacement for the reference's CUDA/DALI
+loaders (BASELINE.json:5 — "grain/tf.data pipelines with device-side HBM
+prefetch"). Synthetic mode (SURVEY.md §2 #5) generates batches on-device for
+data-independent benchmarking (config 1)."""
+
+from distributeddeeplearning_tpu.data.synthetic import (  # noqa: F401
+    SyntheticImages,
+    SyntheticTokens,
+    make_source,
+)

@@ -1,0 +1,119 @@
+"""On-device synthetic batch sources (config 1, BASELINE.json:7).
+
+The reference's synthetic mode fed host-generated fake tensors; on TPU the
+idiomatic version materializes the batch *in HBM* with a tiny jitted program
+— zero host↔device traffic, so the benchmark measures pure step time
+(SURVEY.md §2 #5). Batches are deterministic functions of (seed, step) for
+replay tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+
+MASK_TOKEN_ID = 103  # [MASK] in the BERT-base uncased vocab
+
+
+class _SyntheticSource:
+    """Deterministic on-device batches: jit-compiled generator of (seed, step).
+
+    Subclasses provide ``_generator`` (a closure over static shape params);
+    everything else — jit with output sharding, step folding, iteration —
+    is shared.
+    """
+
+    def __init__(self, generator: Callable, seed: int,
+                 sharding: Optional[jax.sharding.Sharding]):
+        self.seed = seed
+        self._gen = jax.jit(generator, out_shardings=sharding)
+
+    def batch(self, step: int) -> dict:
+        return self._gen(jax.random.key(self.seed), jnp.int32(step))
+
+    def __iter__(self) -> Iterator[dict]:
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1
+
+
+class SyntheticImages(_SyntheticSource):
+    """Fake ImageNet batches, generated in HBM."""
+
+    def __init__(self, batch_size: int, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0,
+                 sharding: Optional[jax.sharding.Sharding] = None):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        super().__init__(
+            functools.partial(_gen_image_batch, batch=batch_size,
+                              size=image_size, num_classes=num_classes),
+            seed, sharding)
+
+
+class SyntheticTokens(_SyntheticSource):
+    """Fake MLM batches: ids, mask-labels (-1 = unmasked)."""
+
+    def __init__(self, batch_size: int, seq_len: int = 128,
+                 vocab_size: int = 30522, mask_prob: float = 0.15,
+                 seed: int = 0,
+                 sharding: Optional[jax.sharding.Sharding] = None):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.mask_prob = mask_prob
+        super().__init__(
+            functools.partial(_gen_token_batch, batch=batch_size,
+                              seq_len=seq_len, vocab=vocab_size,
+                              mask_prob=mask_prob),
+            seed, sharding)
+
+
+def _gen_image_batch(key, step, *, batch, size, num_classes):
+    key = jax.random.fold_in(key, step)
+    k1, k2 = jax.random.split(key)
+    image = jax.random.normal(k1, (batch, size, size, 3), jnp.bfloat16)
+    label = jax.random.randint(k2, (batch,), 0, num_classes, jnp.int32)
+    return {"image": image, "label": label}
+
+
+def _gen_token_batch(key, step, *, batch, seq_len, vocab, mask_prob):
+    key = jax.random.fold_in(key, step)
+    k1, k2 = jax.random.split(key)
+    # Skip the reserved-token id range, but stay in-vocab for small test
+    # vocabularies (ids >= vocab would NaN the cross entropy).
+    lo = min(1000, vocab // 2)
+    ids = jax.random.randint(k1, (batch, seq_len), lo, vocab, jnp.int32)
+    masked = jax.random.bernoulli(k2, mask_prob, (batch, seq_len))
+    labels = jnp.where(masked, ids, -1)
+    input_ids = jnp.where(masked, MASK_TOKEN_ID, ids)
+    return {"input_ids": input_ids, "labels": labels,
+            "attention_mask": jnp.ones((batch, seq_len), jnp.int32)}
+
+
+def make_source(config: TrainConfig, input_kind: str = "image",
+                sharding: Optional[jax.sharding.Sharding] = None):
+    """Synthetic source matching the *model's* input kind (not the dataset
+    string, so `--model bert_base` works with default data settings)."""
+    d: DataConfig = config.data
+    if not d.synthetic:
+        # Real pipelines (grain/tf.data, BASELINE.json:5) attach in
+        # data/imagenet.py; until a data_dir-backed source is wired into
+        # this dispatcher, fall back loudly rather than silently.
+        print("# WARNING: real-data pipeline not wired into make_source yet; "
+              "using synthetic data", file=sys.stderr, flush=True)
+    if input_kind == "tokens":
+        return SyntheticTokens(
+            config.global_batch_size, d.seq_len, d.vocab_size,
+            d.mlm_mask_prob, config.seed, sharding)
+    return SyntheticImages(
+        config.global_batch_size, d.image_size, d.num_classes, config.seed,
+        sharding)

@@ -1,0 +1,69 @@
+"""Model zoo: the architectures named by the acceptance configs
+(BASELINE.json:6-12): ResNet-50/152, DenseNet-121, BERT-base MLM.
+
+``get_model`` is the single registry the trainer/CLI uses; every entry is a
+Flax module plus metadata about its input signature so the trainer stays
+model-agnostic (one trainer, many models — SURVEY.md §2 #1/#2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Registry entry: module factory + input kind ('image' or 'tokens')."""
+
+    name: str
+    build: Callable[..., Any]          # (num_classes/vocab, dtype) -> nn.Module
+    input_kind: str                    # "image" | "tokens"
+    param_count: int                   # known-good total, used by tests
+
+
+def _registry() -> dict[str, ModelSpec]:
+    from distributeddeeplearning_tpu.models import bert, densenet, resnet
+
+    def img(build, name, params):
+        return ModelSpec(name=name, build=build, input_kind="image",
+                         param_count=params)
+
+    return {
+        "resnet18": img(resnet.resnet18, "resnet18", 11_689_512),
+        "resnet34": img(resnet.resnet34, "resnet34", 21_797_672),
+        "resnet50": img(resnet.resnet50, "resnet50", 25_557_032),
+        "resnet101": img(resnet.resnet101, "resnet101", 44_549_160),
+        "resnet152": img(resnet.resnet152, "resnet152", 60_192_808),
+        "densenet121": img(densenet.densenet121, "densenet121", 7_978_856),
+        "densenet169": img(densenet.densenet169, "densenet169", 14_149_480),
+        "bert_base": ModelSpec(
+            name="bert_base", build=bert.bert_base_mlm, input_kind="tokens",
+            param_count=109_514_298),
+        "bert_large": ModelSpec(
+            name="bert_large", build=bert.bert_large_mlm, input_kind="tokens",
+            param_count=335_174_458),
+        # Test/dry-run sized transformer; param_count=0 means "unchecked".
+        "bert_tiny": ModelSpec(
+            name="bert_tiny", build=bert.tiny_bert_mlm, input_kind="tokens",
+            param_count=0),
+    }
+
+
+def get_model(name: str, *, dtype: Any = jnp.bfloat16, **kw: Any):
+    """Build a model module by registry name."""
+    spec = model_spec(name)
+    return spec.build(dtype=dtype, **kw)
+
+
+def model_spec(name: str) -> ModelSpec:
+    reg = _registry()
+    if name not in reg:
+        raise KeyError(f"unknown model {name!r}; have {sorted(reg)}")
+    return reg[name]
+
+
+def available_models() -> tuple[str, ...]:
+    return tuple(sorted(_registry()))

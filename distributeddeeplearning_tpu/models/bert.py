@@ -1,0 +1,175 @@
+"""BERT encoder + masked-LM head in Flax, TPU-first (config 4, BASELINE.json:10).
+
+Sharding-aware by construction: every kernel is annotated with *logical* axis
+names via ``nn.with_logical_partitioning``; parallel/sharding.py maps logical
+axes onto the device mesh (tp shards "mlp"/"heads" on the ``model`` axis, sp
+shards activations on the ``seq`` axis). With a trivial mesh the annotations
+are inert, so single-chip and sharded paths share one module.
+
+The MLM decoder is weight-tied to the word embedding (transpose), matching
+the canonical BERT-base parameterization (109,514,298 params including the
+tied head — asserted in tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+def _dense(features, logical_axes, name, dtype, use_bias=True):
+    return nn.Dense(
+        features, dtype=dtype, param_dtype=jnp.float32, use_bias=use_bias,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), logical_axes),
+        name=name)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # QKV projections: output dim shards on the tensor-parallel axis.
+        q = _dense(cfg.hidden_size, ("embed", "heads"), "query", self.dtype)(x)
+        k = _dense(cfg.hidden_size, ("embed", "heads"), "key", self.dtype)(x)
+        v = _dense(cfg.hidden_size, ("embed", "heads"), "value", self.dtype)(x)
+
+        b, s, _ = q.shape
+        q = q.reshape(b, s, cfg.num_heads, head_dim)
+        k = k.reshape(b, s, cfg.num_heads, head_dim)
+        v = v.reshape(b, s, cfg.num_heads, head_dim)
+
+        scale = head_dim ** -0.5
+        # (B, heads, S, S) scores — contiguous MXU matmuls via einsum.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if mask is not None:
+            big_neg = jnp.finfo(jnp.float32).min
+            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+        probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        # Output projection: input dim sharded -> XLA reduces over tp axis.
+        return _dense(cfg.hidden_size, ("heads", "embed"), "output", self.dtype)(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic: bool):
+        cfg = self.cfg
+        attn = SelfAttention(cfg, self.dtype, name="attention")(
+            x, mask, deterministic=deterministic)
+        attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="attention_ln")(x + attn)
+        h = _dense(cfg.intermediate_size, ("embed", "mlp"), "intermediate",
+                   self.dtype)(x)
+        h = nn.gelu(h, approximate=False)
+        h = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_output", self.dtype)(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                            param_dtype=jnp.float32, name="mlp_ln")(x + h)
+
+
+class BertMLM(nn.Module):
+    """Encoder + transform + tied decoder; returns (B, S, vocab) f32 logits."""
+
+    cfg: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None, *,
+                 train: bool = True):
+        cfg = self.cfg
+        deterministic = not train
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.bool_)
+        else:
+            attention_mask = attention_mask.astype(jnp.bool_)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+
+        word_emb = self.param(
+            "word_embeddings",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        pos_emb = self.param(
+            "position_embeddings",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         (None, "embed")),
+            (cfg.max_position, cfg.hidden_size), jnp.float32)
+        type_emb = self.param(
+            "type_embeddings",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+
+        x = (word_emb[input_ids] + pos_emb[None, :s] + type_emb[token_type_ids])
+        x = x.astype(self.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="embeddings_ln")(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        # Sequence-parallel hint: activations shard (data, seq, -).
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, self.dtype, name=f"layer{i}")(
+                x, attention_mask, deterministic=deterministic)
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        # MLM head: transform -> LayerNorm -> tied decoder + bias.
+        h = _dense(cfg.hidden_size, ("embed", "embed_out"), "mlm_transform",
+                   self.dtype)(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlm_ln")(h)
+        logits = jnp.einsum("bsh,vh->bsv", h, word_emb.astype(self.dtype))
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits.astype(jnp.float32) + bias
+
+
+def bert_base_mlm(vocab_size: int = 30522, dtype: Dtype = jnp.bfloat16,
+                  **overrides: Any) -> BertMLM:
+    cfg = BertConfig(vocab_size=vocab_size, **overrides)
+    return BertMLM(cfg, dtype=dtype)
+
+
+def bert_large_mlm(vocab_size: int = 30522, dtype: Dtype = jnp.bfloat16,
+                   **overrides: Any) -> BertMLM:
+    cfg = BertConfig(vocab_size=vocab_size, hidden_size=1024, num_layers=24,
+                     num_heads=16, intermediate_size=4096, **overrides)
+    return BertMLM(cfg, dtype=dtype)
+
+
+def tiny_bert_mlm(vocab_size: int = 1024, dtype: Dtype = jnp.float32) -> BertMLM:
+    """Test-sized BERT (used by unit tests and dryrun_multichip)."""
+    cfg = BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128, max_position=128)
+    return BertMLM(cfg, dtype=dtype)

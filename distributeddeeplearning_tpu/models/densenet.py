@@ -1,0 +1,95 @@
+"""DenseNet family in Flax, TPU-first (acceptance config 3, BASELINE.json:9).
+
+Same mixed-precision policy as resnet.py (bf16 compute / f32 params+BN
+stats, NHWC). Dense connectivity is expressed as a rolling ``jnp.concatenate``
+on the channel axis — static shapes throughout, so XLA tiles every conv onto
+the MXU. Parameter counts match torchvision densenet{121,169}
+(tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseLayer(nn.Module):
+    """BN-ReLU-1x1 bottleneck (4k) -> BN-ReLU-3x3 (k); returns new features."""
+
+    growth_rate: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = self.norm(name="bn1")(x)
+        y = nn.relu(y)
+        y = self.conv(4 * self.growth_rate, (1, 1), name="conv1")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.growth_rate, (3, 3), name="conv2")(y)
+        return y
+
+
+class DenseNet(nn.Module):
+    """ImageNet DenseNet-BC. NHWC in, float32 logits out."""
+
+    block_sizes: Sequence[int]
+    growth_rate: int = 32
+    num_init_features: int = 64
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(
+                2.0, "fan_out", "normal"),
+            padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+
+        x = jnp.asarray(x, self.dtype)
+        x = conv(self.num_init_features, (7, 7), strides=(2, 2),
+                 name="conv_stem")(x)
+        x = norm(name="bn_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        num_features = self.num_init_features
+        for i, num_layers in enumerate(self.block_sizes):
+            for j in range(num_layers):
+                new = DenseLayer(self.growth_rate, conv, norm,
+                                 name=f"block{i + 1}_layer{j + 1}")(x)
+                x = jnp.concatenate([x, new], axis=-1)
+            num_features += num_layers * self.growth_rate
+            if i != len(self.block_sizes) - 1:
+                # Transition: BN-ReLU-1x1 (halve channels) -> 2x2 avg pool.
+                x = norm(name=f"transition{i + 1}_bn")(x)
+                x = nn.relu(x)
+                num_features //= 2
+                x = conv(num_features, (1, 1), name=f"transition{i + 1}_conv")(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+        x = norm(name="bn_final")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32,
+                     kernel_init=nn.initializers.variance_scaling(
+                         1.0, "fan_in", "truncated_normal"),
+                     name="classifier")(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+def densenet121(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> DenseNet:
+    return DenseNet([6, 12, 24, 16], num_classes=num_classes, dtype=dtype)
+
+
+def densenet169(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> DenseNet:
+    return DenseNet([6, 12, 32, 32], num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,143 @@
+"""ResNet v1.5 family in Flax, TPU-first.
+
+Covers the reference's ResNet-50 ImageNet trainers and the deeper ResNet-152
+acceptance config (BASELINE.json:5,7-9). Design notes for the MXU:
+
+- NHWC layout end-to-end (XLA:TPU's native conv layout; no transposes).
+- compute in ``dtype`` (bfloat16 by default) with float32 parameters and
+  float32 BatchNorm statistics — the standard TPU mixed-precision policy.
+- v1.5 variant (stride-2 on the 3x3 conv of downsampling bottlenecks), the
+  variant used by the throughput benchmarks the north star targets.
+- No data-dependent control flow: the whole forward is one traceable graph.
+
+Parameter counts match torchvision's resnet{18,34,50,101,152} exactly
+(tests/test_models.py asserts this), which substitutes for reference-parity
+checks while /root/reference is empty (SURVEY.md §4 "Numerics").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with expansion 4 (ResNet-50/101/152)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        # v1.5: stride lives on the 3x3, not the first 1x1.
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ImageNet ResNet. ``stage_sizes`` picks the depth; NHWC in, logits out."""
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(
+                2.0, "fan_out", "normal"),
+            padding="SAME")
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+        act = nn.relu
+
+        x = jnp.asarray(x, self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), name="conv_stem")(x)
+        x = norm(name="bn_stem")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(filters=self.width * 2 ** i, strides=strides,
+                               conv=conv, norm=norm, act=act,
+                               name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32,
+                     kernel_init=nn.initializers.variance_scaling(
+                         1.0, "fan_in", "truncated_normal"),
+                     name="classifier")(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet34(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def resnet152(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype=dtype)

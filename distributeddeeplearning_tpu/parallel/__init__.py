@@ -1,0 +1,15 @@
+"""Parallelism: device meshes, sharding rules, and collective-backed train
+steps. This package is the TPU-native replacement for the reference's entire
+communication stack (Horovod C++ core + NCCL + MPI — SURVEY.md §2 #7-#9):
+collectives are emitted by XLA from ``shard_map``/``jit`` sharding
+annotations and ride ICI/DCN; rendezvous is ``jax.distributed``.
+"""
+
+from distributeddeeplearning_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    make_mesh,
+)
+from distributeddeeplearning_tpu.parallel.sharding import (  # noqa: F401
+    logical_rules,
+    mesh_sharding,
+)

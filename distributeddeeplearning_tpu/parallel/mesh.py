@@ -1,0 +1,74 @@
+"""Device-mesh construction.
+
+The mesh is the TPU-native replacement for Horovod's rank/size world
+(SURVEY.md §2 #7-#9): axis ``data`` is the gradient-allreduce axis
+(BASELINE.json:5 "psum over ICI"); ``fsdp`` shards parameters along the same
+data-parallel family; ``model``/``seq``/``expert``/``pipeline`` host tensor,
+sequence, expert, and pipeline parallelism. Size-1 axes are free, so every
+program is written against the full six-axis mesh and collapses cleanly to
+single-chip.
+
+Axis order puts ``model``/``seq`` innermost so tensor/sequence collectives
+(all-gather, ppermute rings) land on the fastest ICI neighbours, while pure-DP
+psums tolerate the outer (slower, possibly DCN) dimensions — the standard
+TPU mesh layout recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from distributeddeeplearning_tpu.config import ParallelConfig
+
+MESH_AXES: tuple[str, ...] = (
+    "pipeline", "data", "fsdp", "expert", "seq", "model")
+
+
+def make_mesh(parallel: ParallelConfig,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh matching ``parallel``'s axis sizes.
+
+    Uses ``mesh_utils.create_device_mesh`` on real TPU platforms so the mesh
+    axes align with the physical ICI torus; falls back to a reshape for CPU
+    test devices (where topology is fake anyway).
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = parallel.axis_sizes()
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(MESH_AXES, shape))} need {n} devices, "
+            f"have {len(devices)}")
+    devices = list(devices)[:n]  # sub-mesh on the first n devices
+    if devices[0].platform == "tpu":
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    else:
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def data_axis_names(parallel: ParallelConfig) -> tuple[str, ...]:
+    """Mesh axes over which the global batch is split (and grads psummed)."""
+    del parallel  # size-1 axes are no-ops, so both are always safe to name
+    return ("data", "fsdp")
+
+
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context manager, across jax API renames.
+
+    Needed so ``with_sharding_constraint``/flax logical constraints can
+    resolve bare PartitionSpecs during tracing.
+    """
+    setter = getattr(jax.sharding, "use_mesh", None) or jax.sharding.set_mesh
+    return setter(mesh)
+
+
+def local_mesh_description(mesh: Mesh) -> str:
+    return ", ".join(f"{a}={s}" for a, s in mesh.shape.items() if s > 1) or "1 device"

@@ -1,0 +1,67 @@
+"""Logical-axis → mesh-axis sharding rules (GSPMD path).
+
+Models annotate kernels with *logical* names (see models/bert.py); this module
+maps them onto the mesh so ``jit`` + ``NamedSharding`` lets XLA insert the
+collectives. This replaces hand-written NCCL calls entirely — the Megatron-style
+tensor-parallel patterns (column-shard QKV/MLP-in, row-shard out-projections,
+vocab-parallel embedding) fall out of three rules on ``model``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import ParallelConfig
+
+
+def logical_rules(parallel: ParallelConfig) -> tuple[tuple[str, Any], ...]:
+    """Rules consumed by ``nn.logical_to_mesh_sharding``.
+
+    - ``batch`` → ("data", "fsdp"): the DP axes (BASELINE.json:5).
+    - ``seq`` → "seq": sequence/context parallelism over activations.
+    - ``heads``/``mlp``/``vocab`` → "model": Megatron-style TP.
+    - ``embed`` → "fsdp": parameter sharding when fsdp>1, else replicated.
+    """
+    rules = [
+        ("batch", ("data", "fsdp")),
+        ("seq", "seq"),
+        ("heads", "model"),
+        ("mlp", "model"),
+        ("vocab", "model"),
+        ("embed", "fsdp" if parallel.fsdp > 1 else None),
+        ("embed_out", None),
+    ]
+    return tuple(rules)
+
+
+def mesh_sharding(tree: Any, mesh: Mesh,
+                  parallel: ParallelConfig) -> Any:
+    """NamedShardings for a pytree carrying flax Partitioned metadata.
+
+    Leaves without metadata (e.g. biases, LayerNorm scales created without
+    ``with_logical_partitioning``) replicate.
+    """
+    specs = nn.get_partition_spec(tree)
+    return nn.logical_to_mesh_sharding(specs, mesh, list(logical_rules(parallel)))
+
+
+def batch_sharding(mesh: Mesh, *, seq_dim: Optional[int] = None) -> NamedSharding:
+    """Input-batch sharding: dim0 over the DP axes, optionally a sequence dim
+    over ``seq`` (sp for token inputs)."""
+    spec = [("data", "fsdp")]
+    if seq_dim is not None:
+        spec += [None] * (seq_dim - 1) + ["seq"]
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def put_replicated(tree: Any, mesh: Mesh) -> Any:
+    """device_put a host pytree fully replicated over the mesh."""
+    return jax.device_put(tree, replicated(mesh))

@@ -1,0 +1,6 @@
+"""Trainers: one model-agnostic train loop serving every acceptance config
+(the reference had one trainer per framework directory — SURVEY.md §2 #1-#3;
+ours is one trainer, many models)."""
+
+from distributeddeeplearning_tpu.train.state import TrainState  # noqa: F401
+from distributeddeeplearning_tpu.train.optim import make_optimizer  # noqa: F401

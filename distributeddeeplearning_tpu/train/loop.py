@@ -1,0 +1,137 @@
+"""The model-agnostic training loop behind ``train.py``.
+
+One loop serves every acceptance config (BASELINE.json:6-12): it selects the
+parallel execution style (explicit-collective DP for CNNs, GSPMD for
+transformer workloads with tp/sp), builds the data source, and drives the
+compiled step with JSONL metrics — the role the reference's per-framework
+``src/train-script.py`` files played (SURVEY.md §2 #1-#3), minus the
+framework forks.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data import synthetic
+from distributeddeeplearning_tpu.models import model_spec
+from distributeddeeplearning_tpu.parallel import mesh as meshlib
+from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.train import optim, steps
+from distributeddeeplearning_tpu.train.state import TrainState
+from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+
+def _dtype(config: TrainConfig):
+    return jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+
+def uses_gspmd(config: TrainConfig, input_kind: str) -> bool:
+    """Transformers (or any config with tp/sp/fsdp axes) take the GSPMD path;
+    pure-DP CNNs take the explicit shard_map+psum path."""
+    p = config.parallel
+    return input_kind == "tokens" or p.model > 1 or p.seq > 1 or p.fsdp > 1
+
+
+def build(config: TrainConfig, total_steps: int):
+    """Construct (mesh, model, source, state, train_step, meta) for a config."""
+    spec = model_spec(config.model)
+    _ = config.per_device_batch  # early, friendly divisibility error
+    mesh = meshlib.make_mesh(config.parallel)
+    dtype = _dtype(config)
+    if spec.input_kind == "tokens":
+        model = spec.build(vocab_size=config.data.vocab_size, dtype=dtype)
+    else:
+        model = spec.build(num_classes=config.data.num_classes, dtype=dtype)
+
+    tx, sched = optim.make_optimizer(
+        config.optimizer, config.global_batch_size, total_steps,
+        config.steps_per_epoch)
+    rng = jax.random.key(config.seed)
+
+    seq_dim = 1 if spec.input_kind == "tokens" else None
+    batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
+    source = synthetic.make_source(config, spec.input_kind, sharding=batch_shd)
+
+    if uses_gspmd(config, spec.input_kind):
+        example = source.batch(0)
+        state, shardings = steps.init_sharded_state(
+            model, tx, mesh, config, example, rng, spec.input_kind)
+        train_step = steps.make_gspmd_train_step(
+            model, tx, mesh, config, shardings, spec.input_kind)
+    else:
+        def init_fn(rng):
+            if spec.input_kind == "tokens":
+                variables = model.init(
+                    {"params": rng, "dropout": rng},
+                    jnp.zeros((1, config.data.seq_len), jnp.int32),
+                    train=False)
+            else:
+                size = config.data.image_size
+                variables = model.init(
+                    {"params": rng}, jnp.zeros((1, size, size, 3), dtype),
+                    train=False)
+            params = variables["params"]
+            return TrainState.create(
+                params=params, opt_state=tx.init(params),
+                batch_stats=variables.get("batch_stats"))
+
+        replicated = shardlib.replicated(mesh)
+        state = jax.jit(init_fn, out_shardings=replicated)(rng)
+        train_step = steps.make_dp_train_step(
+            model, tx, mesh, config, spec.input_kind)
+
+    return mesh, model, source, state, train_step, sched, rng
+
+
+def run(config: TrainConfig, *, total_steps: int,
+        logger: Optional[MetricLogger] = None,
+        warmup_steps: int = 0) -> dict[str, Any]:
+    """Train for ``total_steps``; returns a summary with throughput.
+
+    ``warmup_steps`` are excluded from timing (compile + first-step cost),
+    matching the reference benchmark harness semantics (SURVEY.md §3.4).
+    """
+    logger = logger or MetricLogger()
+    mesh, model, source, state, train_step, sched, rng = build(
+        config, total_steps)
+    if jax.process_index() == 0:
+        # stderr so harness consumers (bench.py) keep a clean stdout
+        print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
+              f"model={config.model} global_batch={config.global_batch_size} "
+              f"dtype={config.dtype}", file=sys.stderr, flush=True)
+
+    metrics = {}
+    timed_examples = 0
+    # warmup_steps == 0 means "time everything" (incl. compile).
+    t_timed = time.perf_counter() if warmup_steps == 0 else None
+    for i in range(total_steps):
+        state, metrics = train_step(state, source.batch(i), rng)
+        if i + 1 == warmup_steps:
+            jax.block_until_ready(metrics)
+            t_timed = time.perf_counter()
+        if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
+            jax.block_until_ready(metrics)
+            logger.log(int(i + 1), metrics,
+                       examples_per_step=config.global_batch_size,
+                       lr=float(sched(i)))
+        if i >= warmup_steps:
+            timed_examples += config.global_batch_size
+
+    jax.block_until_ready(state)
+    summary: dict[str, Any] = {
+        "final_step": total_steps,
+        "final_metrics": {k: float(v) for k, v in metrics.items()},
+    }
+    if t_timed is not None and timed_examples:
+        elapsed = time.perf_counter() - t_timed
+        summary["examples_per_sec"] = timed_examples / elapsed
+        summary["examples_per_sec_per_chip"] = (
+            summary["examples_per_sec"] / jax.device_count())
+        summary["steps_per_sec"] = (total_steps - warmup_steps) / elapsed
+    return summary

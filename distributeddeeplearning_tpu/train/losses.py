@@ -1,0 +1,43 @@
+"""Loss functions shared by the trainers.
+
+Float32 loss math regardless of compute dtype (logits are emitted f32 by
+every model in the zoo) — bf16 softmax/CE is where mixed-precision training
+silently loses accuracy, so it stays full precision.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def smoothed_softmax_ce(logits: jnp.ndarray, labels: jnp.ndarray,
+                        smoothing: float = 0.1) -> jnp.ndarray:
+    """Label-smoothed cross entropy, mean over the batch. (B,C) x (B,) -> ()."""
+    num_classes = logits.shape[-1]
+    if smoothing:
+        one_hot = optax.smooth_labels(
+            jnp.eye(num_classes, dtype=jnp.float32)[labels], smoothing)
+        loss = optax.softmax_cross_entropy(logits, one_hot)
+    else:
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return loss.mean()
+
+
+def top1_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+
+
+def mlm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Masked-LM cross entropy over positions with label >= 0.
+
+    ``labels`` is (B, S) int32 with -1 at unmasked positions (the ignore
+    index). Mean over masked positions, guarded against an all-unmasked batch.
+    """
+    weights = (labels >= 0).astype(jnp.float32)
+    safe_labels = jnp.maximum(labels, 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, safe_labels)
+    total = (per_tok * weights).sum()
+    denom = jnp.maximum(weights.sum(), 1.0)
+    return total / denom

@@ -1,0 +1,91 @@
+"""Optimizers + LR schedules (optax).
+
+Covers the acceptance matrix: SGD-momentum for the ResNet/DenseNet DP configs
+(BASELINE.json:7-9), AdamW for BERT MLM (BASELINE.json:10), and LARS with the
+linear-scaling + warmup + polynomial-decay recipe for batch=32k
+(BASELINE.json:11; recipe per PAPERS.md:8-9 large-batch papers).
+
+Weight decay is masked off BatchNorm/LayerNorm parameters and biases — the
+standard large-batch convention; for LARS the same mask also disables the
+trust-ratio rescaling on those leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax
+import jax.numpy as jnp
+import optax
+
+from distributeddeeplearning_tpu.config import OptimizerConfig
+
+
+def _decay_mask(params: Any) -> Any:
+    """True for leaves that get weight decay: kernels/embeddings only."""
+    flat = flax.traverse_util.flatten_dict(params)
+    mask = {
+        path: (path[-1] == "kernel" or "embedding" in path[-1])
+        for path in flat
+    }
+    return flax.traverse_util.unflatten_dict(mask)
+
+
+def scaled_lr(cfg: OptimizerConfig, global_batch: int) -> float:
+    """Linear-scaling rule: lr = base_lr * batch / reference_batch."""
+    return cfg.learning_rate * global_batch / cfg.reference_batch
+
+
+def make_schedule(cfg: OptimizerConfig, global_batch: int,
+                  total_steps: int,
+                  steps_per_epoch: Optional[int] = None) -> optax.Schedule:
+    peak = scaled_lr(cfg, global_batch)
+    warmup = int(cfg.warmup_epochs * steps_per_epoch) if steps_per_epoch \
+        else max(int(0.05 * total_steps), 1)
+    warmup = min(warmup, max(total_steps - 1, 1))
+    if cfg.schedule == "constant":
+        return optax.constant_schedule(peak)
+    if cfg.schedule == "linear":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, peak, warmup),
+             optax.linear_schedule(peak, 0.0, max(total_steps - warmup, 1))],
+            [warmup])
+    if cfg.schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=peak, warmup_steps=warmup,
+            decay_steps=max(total_steps, warmup + 1))
+    if cfg.schedule == "warmup_poly":
+        # LARS paper recipe: warmup then polynomial (power-2) decay to 0.
+        poly = optax.polynomial_schedule(
+            init_value=peak, end_value=0.0, power=2,
+            transition_steps=max(total_steps - warmup, 1))
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, peak, warmup), poly], [warmup])
+    raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+
+def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
+                   steps_per_epoch: Optional[int] = None
+                   ) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    sched = make_schedule(cfg, global_batch, total_steps, steps_per_epoch)
+    if cfg.name == "sgd":
+        tx = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
+            optax.sgd(sched, momentum=cfg.momentum, nesterov=False),
+        )
+    elif cfg.name == "lars":
+        tx = optax.lars(
+            sched, weight_decay=cfg.weight_decay,
+            weight_decay_mask=_decay_mask,
+            trust_coefficient=cfg.trust_coefficient,
+            trust_ratio_mask=_decay_mask,
+            momentum=cfg.momentum)
+    elif cfg.name == "adamw":
+        tx = optax.adamw(
+            sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay, mask=_decay_mask)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if cfg.grad_clip_norm:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    return tx, sched

@@ -1,0 +1,26 @@
+"""Train state pytree: params + optimizer state + BN statistics + step.
+
+A plain ``flax.struct`` pytree (not TrainState from flax.training) so the
+whole state threads through ``jit``/``shard_map`` and orbax untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # scalar int32
+    params: Any                       # model parameters (f32)
+    opt_state: Any                    # optax state
+    batch_stats: Any = None           # BN running stats (CNNs) or None
+
+    @classmethod
+    def create(cls, *, params: Any, opt_state: Any,
+               batch_stats: Optional[Any] = None) -> "TrainState":
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=opt_state, batch_stats=batch_stats)

@@ -1,0 +1,244 @@
+"""Compiled train/eval steps — the heart of the port.
+
+Two parallel execution styles, both single XLA programs per step
+(BASELINE.json:5: "replace hvd.DistributedOptimizer / hvd.allreduce with
+jax.pmap/pjit emitting XLA psum over ICI"):
+
+1. ``make_dp_train_step`` — ``shard_map`` over the (data, fsdp) mesh axes
+   with replicated parameters and an explicit ``lax.pmean`` on gradients.
+   This is the literal Horovod-semantics path for the CNN configs: local
+   BatchNorm (per-shard statistics, like per-GPU BN under Horovod), gradient
+   averaging across shards, identical parameter update everywhere. The
+   backward-hook + background-thread + fusion-buffer machinery of Horovod's
+   C++ core collapses into XLA scheduling fused all-reduces over ICI
+   (SURVEY.md §3.1).
+
+2. ``make_gspmd_train_step`` — ``jit`` + ``NamedSharding`` with logical-axis
+   rules (parallel/sharding.py). Used for transformer workloads where
+   parameters themselves shard (tp/fsdp) and activations shard over batch
+   and sequence (dp/sp); XLA inserts every collective.
+
+Both donate the input state (in-place update in HBM, no copy).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+from distributeddeeplearning_tpu.train import losses
+from distributeddeeplearning_tpu.train.state import TrainState
+
+DATA_AXES = ("data", "fsdp")
+
+
+# ---------------------------------------------------------------------------
+# Forward/loss closures per input kind
+# ---------------------------------------------------------------------------
+
+def _image_loss_fn(model, config: TrainConfig):
+    smoothing = config.optimizer.label_smoothing
+
+    def loss_fn(params, batch_stats, batch, rng):
+        del rng  # CNNs here have no dropout
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+        out, mutated = model.apply(
+            variables, batch["image"], train=True, mutable=["batch_stats"])
+        loss = losses.smoothed_softmax_ce(out, batch["label"], smoothing)
+        metrics = {"loss": loss,
+                   "accuracy": losses.top1_accuracy(out, batch["label"])}
+        return loss, (mutated.get("batch_stats"), metrics)
+
+    return loss_fn
+
+
+def _token_loss_fn(model, config: TrainConfig):
+    del config
+
+    def loss_fn(params, batch_stats, batch, rng):
+        del batch_stats
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            train=True, rngs={"dropout": rng})
+        loss = losses.mlm_loss(logits, batch["labels"])
+        return loss, (None, {"loss": loss})
+
+    return loss_fn
+
+
+def loss_fn_for(model, input_kind: str, config: TrainConfig):
+    if input_kind == "image":
+        return _image_loss_fn(model, config)
+    if input_kind == "tokens":
+        return _token_loss_fn(model, config)
+    raise ValueError(f"unknown input kind {input_kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Path 1: explicit-collective DP (shard_map + psum) — Horovod semantics
+# ---------------------------------------------------------------------------
+
+def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                       config: TrainConfig, input_kind: str = "image"
+                       ) -> Callable[[TrainState, Any, jax.Array],
+                                     tuple[TrainState, dict]]:
+    """Build the jitted data-parallel train step.
+
+    state: fully replicated. batch: leading dim sharded over (data, fsdp).
+    Gradients (and BN running-stat updates) are ``pmean``-ed over the DP axes
+    — the exact allreduce-average Horovod performs — so parameters stay
+    bit-identical on every shard.
+    """
+    loss_fn = loss_fn_for(model, input_kind, config)
+    dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
+
+    def step_fn(state: TrainState, batch, rng):
+        # Per-shard RNG: fold in the linearized DP coordinate.
+        idx = jax.lax.axis_index(DATA_AXES)
+        rng = jax.random.fold_in(jax.random.fold_in(rng, idx), state.step)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (new_bn, metrics)), grads = grad_fn(
+            state.params, state.batch_stats, batch, rng)
+
+        # The allreduce: params enter replicated (in_spec P()), so shard_map's
+        # autodiff transpose has ALREADY psummed the per-shard gradients over
+        # ICI (the sum is required for `grads` to be replicated, which
+        # check_vma enforces). Dividing by the shard count turns the Horovod
+        # ring-allreduce-sum into the gradient *average* hvd applies.
+        grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
+        metrics = jax.lax.pmean(metrics, DATA_AXES)
+        if new_bn is not None:
+            # Sync running statistics (cheap; normalization itself stayed
+            # local per shard, matching per-GPU BN under Horovod).
+            new_bn = jax.lax.pmean(new_bn, DATA_AXES)
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt, batch_stats=new_bn)
+        return new_state, metrics
+
+    batch_spec = P(DATA_AXES)
+    mapped = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), batch_spec, P()),
+        out_specs=(P(), P()))
+    return jax.jit(mapped, donate_argnums=0)
+
+
+def make_dp_eval_step(model, mesh: Mesh, config: TrainConfig):
+    """Eval: per-shard correct-count, psum before dividing (SURVEY.md §3.5)."""
+    del config
+
+    def eval_fn(state: TrainState, batch):
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["image"], train=False)
+        correct = (jnp.argmax(logits, -1) == batch["label"]).sum()
+        total = jnp.asarray(batch["label"].shape[0], jnp.int32)
+        correct = jax.lax.psum(correct, DATA_AXES)
+        total = jax.lax.psum(total, DATA_AXES)
+        return {"correct": correct, "total": total}
+
+    mapped = jax.shard_map(
+        eval_fn, mesh=mesh, in_specs=(P(), P(DATA_AXES)),
+        out_specs=P())
+    return jax.jit(mapped)
+
+
+# ---------------------------------------------------------------------------
+# Path 2: GSPMD (jit + NamedSharding) — tp/sp/fsdp for transformers
+# ---------------------------------------------------------------------------
+
+def _unreplicated_rules_ctx(config: TrainConfig):
+    return nn.logical_axis_rules(list(shardlib.logical_rules(config.parallel)))
+
+
+def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
+                       example_batch: Any, rng: jax.Array,
+                       input_kind: str = "tokens"):
+    """Initialize a TrainState whose params/opt-state are laid out per the
+    logical sharding rules, created directly on-device via jit out_shardings
+    (no host-side full materialization)."""
+
+    def init_fn(rng):
+        with _unreplicated_rules_ctx(config):
+            if input_kind == "tokens":
+                variables = model.init(
+                    {"params": rng, "dropout": rng},
+                    example_batch["input_ids"], train=False)
+            else:
+                variables = model.init(
+                    {"params": rng}, example_batch["image"], train=False)
+        params = variables["params"]
+        opt_state = tx.init(params)
+        return TrainState.create(
+            params=params, opt_state=opt_state,
+            batch_stats=variables.get("batch_stats"))
+
+    abstract = jax.eval_shape(init_fn, rng)
+    with _unreplicated_rules_ctx(config):
+        specs = nn.logical_to_mesh(nn.get_partition_spec(abstract))
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    with use_mesh(mesh):
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
+                          state_shardings, input_kind: str = "tokens"):
+    loss_fn = loss_fn_for(model, input_kind, config)
+    # Token batches are (B, S): dim 0 over the DP axes, dim 1 over `seq`.
+    seq_dim = 1 if input_kind == "tokens" else None
+    batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
+
+    def step_fn(state: TrainState, batch, rng):
+        rng = jax.random.fold_in(rng, state.step)
+        with _unreplicated_rules_ctx(config):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_, (new_bn, metrics)), grads = grad_fn(
+                state.params, state.batch_stats, batch, rng)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt, batch_stats=new_bn)
+        return new_state, metrics
+
+    def batch_shardings(batch):
+        return jax.tree_util.tree_map(
+            lambda x: batch_shd if getattr(x, "ndim", 0) >= 1
+            else NamedSharding(mesh, P()), batch)
+
+    jit_cache: dict = {}
+
+    def compiled(state, batch, rng):
+        # One jit wrapper per batch structure — recreating the wrapper per
+        # call would discard the compilation cache.
+        key = jax.tree_util.tree_structure(batch)
+        if key not in jit_cache:
+            jit_cache[key] = jax.jit(
+                step_fn,
+                in_shardings=(state_shardings, batch_shardings(batch),
+                              NamedSharding(mesh, P())),
+                out_shardings=(state_shardings, NamedSharding(mesh, P())),
+                donate_argnums=0)
+        with use_mesh(mesh):
+            return jit_cache[key](state, batch, rng)
+
+    return compiled

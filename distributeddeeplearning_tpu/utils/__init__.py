@@ -1,0 +1,3 @@
+"""Utilities: structured metric logging, timing, host helpers."""
+
+from distributeddeeplearning_tpu.utils.logging import MetricLogger  # noqa: F401

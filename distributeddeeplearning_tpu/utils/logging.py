@@ -1,0 +1,57 @@
+"""Structured per-step metrics: JSONL to stdout/file, process-0 only.
+
+Replaces the reference's free-form stdout prints (SURVEY.md §5.5); the
+benchmark harness parses the same records, so training and benchmarking share
+one observability path.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, IO, Optional
+
+import jax
+
+
+def is_chief() -> bool:
+    return jax.process_index() == 0
+
+
+class MetricLogger:
+    """Rank-0 JSONL metric writer with wall-clock throughput accounting."""
+
+    def __init__(self, stream: Optional[IO[str]] = None,
+                 file_path: Optional[str] = None, enabled: Optional[bool] = None):
+        self.stream = stream or sys.stdout
+        self.file = open(file_path, "a") if file_path else None
+        self.enabled = is_chief() if enabled is None else enabled
+        self._last_time: Optional[float] = None
+        self._last_step: Optional[int] = None
+
+    def log(self, step: int, metrics: dict[str, Any], *,
+            examples_per_step: Optional[int] = None, **extra: Any) -> dict:
+        now = time.perf_counter()
+        record: dict[str, Any] = {"step": int(step)}
+        for k, v in metrics.items():
+            record[k] = float(v) if hasattr(v, "__float__") else v
+        if (examples_per_step and self._last_time is not None
+                and step > self._last_step):
+            dt = (now - self._last_time) / (step - self._last_step)
+            record["step_time_s"] = round(dt, 6)
+            record["examples_per_sec"] = round(examples_per_step / dt, 2)
+        record.update(extra)
+        self._last_time = now
+        self._last_step = step
+        if self.enabled:
+            line = json.dumps(record)
+            print(line, file=self.stream, flush=True)
+            if self.file:
+                self.file.write(line + "\n")
+                self.file.flush()
+        return record
+
+    def close(self) -> None:
+        if self.file:
+            self.file.close()

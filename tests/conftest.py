@@ -1,0 +1,30 @@
+"""Test env: 8 fake CPU devices, no TPU (SURVEY.md §4 "Distributed-without-
+a-cluster").
+
+The image's axon sitecustomize imports jax at interpreter start and pins
+``jax_platforms`` via jax.config, so env vars alone are too late here; we
+must override through jax.config. XLA_FLAGS still works because no backend
+client exists until first use.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # for any subprocesses we spawn
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,77 @@
+"""Synthetic source determinism (SURVEY.md §4) + loss-function unit tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.data.synthetic import (
+    SyntheticImages, SyntheticTokens)
+from distributeddeeplearning_tpu.train import losses
+
+
+def test_synthetic_images_deterministic():
+    a = SyntheticImages(4, 16, 10, seed=0)
+    b = SyntheticImages(4, 16, 10, seed=0)
+    ba, bb = a.batch(3), b.batch(3)
+    np.testing.assert_array_equal(np.asarray(ba["image"], np.float32),
+                                  np.asarray(bb["image"], np.float32))
+    np.testing.assert_array_equal(ba["label"], bb["label"])
+    b4 = a.batch(4)
+    assert not np.array_equal(np.asarray(ba["image"], np.float32),
+                              np.asarray(b4["image"], np.float32))
+
+
+def test_synthetic_images_shapes_dtypes():
+    src = SyntheticImages(8, 32, 100, seed=1)
+    b = src.batch(0)
+    assert b["image"].shape == (8, 32, 32, 3)
+    assert b["image"].dtype == jnp.bfloat16
+    assert b["label"].shape == (8,)
+    assert int(b["label"].min()) >= 0 and int(b["label"].max()) < 100
+
+
+def test_synthetic_tokens_masking():
+    src = SyntheticTokens(4, 64, 1000, mask_prob=0.25, seed=0)
+    b = src.batch(0)
+    masked = b["labels"] >= 0
+    # masked positions carry the [MASK] id in inputs, original id in labels
+    assert bool((b["input_ids"][masked] == 103).all())
+    frac = float(masked.mean())
+    assert 0.1 < frac < 0.45
+    unmasked = ~masked
+    assert bool((b["labels"][unmasked] == -1).all())
+
+
+def test_synthetic_tokens_small_vocab_in_range():
+    """Regression: vocab smaller than the reserved-id offset must still
+    produce in-vocab ids (out-of-range labels NaN the cross entropy)."""
+    src = SyntheticTokens(4, 16, 512, seed=0)
+    b = src.batch(0)
+    assert int(b["labels"].max()) < 512
+    assert int(b["input_ids"].max()) < 512
+
+
+def test_mlm_loss_ignores_unmasked():
+    logits = jax.random.normal(jax.random.key(0), (2, 8, 50))
+    labels_none = jnp.full((2, 8), -1)
+    # all-unmasked batch: guarded, returns 0
+    assert float(losses.mlm_loss(logits, labels_none)) == 0.0
+    labels = labels_none.at[0, 0].set(7)
+    expected = -jax.nn.log_softmax(logits[0, 0])[7]
+    np.testing.assert_allclose(float(losses.mlm_loss(logits, labels)),
+                               float(expected), rtol=1e-6)
+
+
+def test_label_smoothing_matches_manual():
+    logits = jax.random.normal(jax.random.key(1), (4, 10))
+    labels = jnp.array([1, 2, 3, 4])
+    got = losses.smoothed_softmax_ce(logits, labels, smoothing=0.1)
+    onehot = jax.nn.one_hot(labels, 10) * 0.9 + 0.1 / 10
+    manual = (-(onehot * jax.nn.log_softmax(logits)).sum(-1)).mean()
+    np.testing.assert_allclose(float(got), float(manual), rtol=1e-6)
+
+
+def test_top1_accuracy():
+    logits = jnp.array([[1.0, 2.0], [3.0, 0.0]])
+    assert float(losses.top1_accuracy(logits, jnp.array([1, 0]))) == 1.0
+    assert float(losses.top1_accuracy(logits, jnp.array([0, 0]))) == 0.5

@@ -1,0 +1,89 @@
+"""GSPMD path: tp/sp sharding correctness for the transformer workloads
+(config 4). Checks that (a) kernels actually shard per the Megatron rules,
+(b) a dp x sp x tp step runs and trains, (c) the sharded forward matches the
+unsharded forward numerically (XLA collectives preserve semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import (
+    DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.data.synthetic import SyntheticTokens
+from distributeddeeplearning_tpu.models import bert
+from distributeddeeplearning_tpu.parallel.mesh import make_mesh
+from distributeddeeplearning_tpu.train import optim, steps
+
+
+def bert_cfg(parallel: ParallelConfig) -> TrainConfig:
+    return TrainConfig(
+        model="bert_tiny", global_batch_size=8, dtype="float32",
+        parallel=parallel,
+        data=DataConfig(dataset="mlm", seq_len=32, vocab_size=1024),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3,
+                                  schedule="linear", label_smoothing=0.0))
+
+
+def build_sharded(parallel, devices8):
+    cfg = bert_cfg(parallel)
+    mesh = make_mesh(cfg.parallel)
+    model = bert.tiny_bert_mlm(vocab_size=1024)
+    tx, _ = optim.make_optimizer(cfg.optimizer, cfg.global_batch_size, 100)
+    src = SyntheticTokens(8, 32, 1024, seed=7)
+    rng = jax.random.key(0)
+    state, shardings = steps.init_sharded_state(
+        model, tx, mesh, cfg, src.batch(0), rng, "tokens")
+    step = steps.make_gspmd_train_step(model, tx, mesh, cfg, shardings,
+                                       "tokens")
+    return cfg, mesh, model, src, state, step, rng
+
+
+def test_tp_kernel_sharding(devices8):
+    _, mesh, _, _, state, _, _ = build_sharded(
+        ParallelConfig(data=2, seq=2, model=2), devices8)
+    qk = state.params["layer0"]["attention"]["query"]["kernel"].value
+    assert qk.sharding.spec == P(None, "model"), qk.sharding
+    mlp_in = state.params["layer0"]["intermediate"]["kernel"].value
+    assert mlp_in.sharding.spec == P(None, "model")
+    mlp_out = state.params["layer0"]["mlp_output"]["kernel"].value
+    assert mlp_out.sharding.spec == P("model", None)
+    emb = state.params["word_embeddings"].value
+    assert emb.sharding.spec == P("model", None)  # vocab-parallel
+
+
+def test_tp_sp_step_trains(devices8):
+    _, _, _, src, state, step, rng = build_sharded(
+        ParallelConfig(data=2, seq=2, model=2), devices8)
+    fixed = src.batch(0)
+    first = last = None
+    for i in range(8):
+        state, metrics = step(state, fixed, rng)
+        if first is None:
+            first = float(metrics["loss"])
+        last = float(metrics["loss"])
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first, (first, last)
+
+
+def test_sharded_forward_matches_unsharded(devices8):
+    """Forward logits under dp x sp x tp == single-device logits."""
+    model = bert.tiny_bert_mlm(vocab_size=1024)
+    ids = jax.random.randint(jax.random.key(3), (4, 32), 0, 1024)
+    variables = model.init({"params": jax.random.key(0),
+                            "dropout": jax.random.key(1)}, ids, train=False)
+    ref = model.apply(variables, ids, train=False)
+
+    cfg = bert_cfg(ParallelConfig(data=2, seq=2, model=2))
+    mesh = make_mesh(cfg.parallel)
+    from distributeddeeplearning_tpu.parallel import sharding as shardlib
+    from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+    import flax.linen as nn
+
+    with use_mesh(mesh), nn.logical_axis_rules(
+            list(shardlib.logical_rules(cfg.parallel))):
+        sharded = jax.jit(
+            lambda v, x: model.apply(v, x, train=False))(variables, ids)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(sharded),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,83 @@
+"""Model-zoo unit tests: parameter counts vs canonical values and forward
+shapes (SURVEY.md §4 "Unit"). Counts are checked against the torchvision /
+HuggingFace canonical totals, substituting for reference parity while
+/root/reference is empty."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu import models
+
+
+def count_params(tree) -> int:
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def abstract_init(name: str):
+    spec = models.model_spec(name)
+    if spec.input_kind == "tokens":
+        model = spec.build(dtype=jnp.float32)
+        return jax.eval_shape(
+            lambda r: model.init({"params": r, "dropout": r},
+                                 jnp.zeros((1, 16), jnp.int32), train=False),
+            jax.random.key(0))
+    model = spec.build(dtype=jnp.float32)
+    return jax.eval_shape(
+        lambda r: model.init({"params": r},
+                             jnp.zeros((1, 224, 224, 3), jnp.float32),
+                             train=False),
+        jax.random.key(0))
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "densenet121", "densenet169", "bert_base", "bert_large",
+])
+def test_param_counts(name):
+    spec = models.model_spec(name)
+    variables = abstract_init(name)
+    got = count_params(variables["params"])
+    assert got == spec.param_count, (
+        f"{name}: {got:,} params, expected {spec.param_count:,}")
+
+
+def test_resnet50_forward_shape_and_finite():
+    model = models.get_model("resnet50", dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 64, 64, 3))
+    variables = model.init({"params": jax.random.key(1)}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_densenet_forward_shape():
+    model = models.get_model("densenet121", dtype=jnp.float32)
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = model.init({"params": jax.random.key(0)}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 1000)
+
+
+def test_bert_tiny_forward_shape():
+    model = models.get_model("bert_tiny", dtype=jnp.float32)
+    ids = jnp.ones((2, 16), jnp.int32)
+    variables = model.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
+                           ids, train=False)
+    logits = model.apply(variables, ids, train=False)
+    assert logits.shape == (2, 16, 1024)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_bn_stats_update():
+    model = models.get_model("resnet18", dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    variables = model.init({"params": jax.random.key(1)}, x, train=False)
+    _, mutated = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))

@@ -1,0 +1,86 @@
+"""Optimizer/schedule unit tests (SURVEY.md §4): LARS trust-ratio math on toy
+tensors, schedule shapes, linear-scaling rule, decay masking."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearning_tpu.config import OptimizerConfig
+from distributeddeeplearning_tpu.train import optim
+
+
+def test_linear_scaling_rule():
+    cfg = OptimizerConfig(learning_rate=0.1, reference_batch=256)
+    assert optim.scaled_lr(cfg, 256) == 0.1
+    assert abs(optim.scaled_lr(cfg, 32768) - 12.8) < 1e-9
+
+
+def test_warmup_cosine_shape():
+    cfg = OptimizerConfig(schedule="warmup_cosine", warmup_epochs=5)
+    sched = optim.make_schedule(cfg, 256, total_steps=1000, steps_per_epoch=10)
+    assert float(sched(0)) == 0.0
+    peak = optim.scaled_lr(cfg, 256)
+    np.testing.assert_allclose(float(sched(50)), peak, rtol=1e-6)
+    assert float(sched(999)) < peak * 0.01 + 1e-6
+
+
+def test_warmup_poly_lars_schedule():
+    cfg = OptimizerConfig(name="lars", schedule="warmup_poly",
+                          learning_rate=29.0, reference_batch=32768,
+                          warmup_epochs=5)
+    sched = optim.make_schedule(cfg, 32768, total_steps=100,
+                                steps_per_epoch=4)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(20)), 29.0, rtol=1e-6)
+    assert float(sched(100)) <= 1e-6
+
+
+def test_decay_mask_excludes_bn_and_bias():
+    params = {
+        "conv": {"kernel": jnp.ones((3, 3, 1, 1))},
+        "bn": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))},
+        "word_embeddings": jnp.ones((10, 4)),
+    }
+    mask = optim._decay_mask(params)
+    assert mask["conv"]["kernel"] is True
+    assert mask["bn"]["scale"] is False
+    assert mask["bn"]["bias"] is False
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["word_embeddings"] is True
+
+
+def test_lars_trust_ratio_toy():
+    """LARS update magnitude ~ lr * trust_coeff * ||w|| / ||g|| * ||g||."""
+    import optax
+    cfg = OptimizerConfig(name="lars", schedule="constant", learning_rate=1.0,
+                          reference_batch=256, momentum=0.0,
+                          weight_decay=0.0, trust_coefficient=0.01)
+    tx, _ = optim.make_optimizer(cfg, 256, total_steps=10)
+    params = {"dense": {"kernel": jnp.full((4, 4), 2.0)}}
+    grads = {"dense": {"kernel": jnp.full((4, 4), 0.5)}}
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    u = updates["dense"]["kernel"]
+    w_norm = float(jnp.linalg.norm(params["dense"]["kernel"]))
+    g_norm = float(jnp.linalg.norm(grads["dense"]["kernel"]))
+    expected = -1.0 * cfg.trust_coefficient * w_norm / g_norm * 0.5
+    np.testing.assert_allclose(np.asarray(u), expected, rtol=1e-5)
+
+
+def test_sgd_momentum_step():
+    cfg = OptimizerConfig(name="sgd", schedule="constant", learning_rate=0.1,
+                          reference_batch=256, momentum=0.9,
+                          weight_decay=0.0)
+    tx, _ = optim.make_optimizer(cfg, 256, total_steps=10)
+    params = {"dense": {"kernel": jnp.ones((2,))}}
+    grads = {"dense": {"kernel": jnp.ones((2,))}}
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(updates["dense"]["kernel"]), -0.1,
+                               rtol=1e-6)
+    updates, state = tx.update(grads, state, params)
+    # second step: momentum buffer = 1*0.9 + 1 = 1.9 -> update = -0.19
+    np.testing.assert_allclose(np.asarray(updates["dense"]["kernel"]), -0.19,
+                               rtol=1e-6)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Training entrypoint — the reference's ``train.py`` CLI surface, TPU-native.
+
+BASELINE.json:5 requires "the existing train.py entrypoints and benchmark
+harness run unchanged from the CLI with --backend=tpu"; this is that CLI.
+Pick an acceptance config by name (``--config``, see BASELINE.json:6-12) or
+assemble one from flags.
+
+Examples:
+    python train.py --config resnet50_synthetic --steps 100
+    python train.py --model resnet50 --batch-size 256 --dp 8 --backend tpu
+    python train.py --config bert_base_mlm --steps 50 --tp 2 --sp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--config", default=None,
+                   help="acceptance-config preset name (see --list-configs)")
+    p.add_argument("--list-configs", action="store_true")
+    p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"],
+                   help="device backend (BASELINE.json:5)")
+    p.add_argument("--model", default=None, help="model registry name")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch size")
+    p.add_argument("--steps", type=int, default=None,
+                   help="total train steps (overrides --epochs)")
+    p.add_argument("--epochs", type=float, default=None)
+    p.add_argument("--synthetic", action="store_true", default=None,
+                   help="on-device synthetic data (config 1)")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--dp", type=int, default=None, help="data-parallel size")
+    p.add_argument("--fsdp", type=int, default=None)
+    p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
+    p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
+    p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw"])
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--warmup-steps", type=int, default=2,
+                   help="steps excluded from throughput timing")
+    p.add_argument("--checkpoint-dir", default=None)
+    return p.parse_args(argv)
+
+
+def build_config(args: argparse.Namespace):
+    from distributeddeeplearning_tpu import config as cfglib
+
+    cfg = cfglib.preset(args.config) if args.config else cfglib.TrainConfig()
+    if args.model:
+        cfg = cfg.replace(model=args.model)
+    if args.batch_size:
+        cfg = cfg.replace(global_batch_size=args.batch_size)
+    if args.epochs:
+        cfg = cfg.replace(num_epochs=args.epochs)
+    if args.dtype:
+        cfg = cfg.replace(dtype=args.dtype)
+    if args.seed is not None:
+        cfg = cfg.replace(seed=args.seed)
+    if args.log_every:
+        cfg = cfg.replace(log_every=args.log_every)
+    if args.checkpoint_dir:
+        cfg = cfg.replace(checkpoint_dir=args.checkpoint_dir)
+    cfg = cfg.replace(backend=args.backend)
+
+    par = cfg.parallel
+    updates = {}
+    if args.dp is not None:
+        updates["data"] = args.dp
+    if args.fsdp is not None:
+        updates["fsdp"] = args.fsdp
+    if args.tp is not None:
+        updates["model"] = args.tp
+    if args.sp is not None:
+        updates["seq"] = args.sp
+    if updates:
+        cfg = cfg.replace(parallel=dataclasses.replace(par, **updates))
+
+    data_updates = {}
+    if args.synthetic is not None:
+        data_updates["synthetic"] = True
+    if args.data_dir:
+        data_updates["data_dir"] = args.data_dir
+        data_updates["synthetic"] = False
+    if data_updates:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_updates))
+
+    opt_updates = {}
+    if args.optimizer:
+        opt_updates["name"] = args.optimizer
+    if args.lr is not None:
+        opt_updates["learning_rate"] = args.lr
+    if opt_updates:
+        cfg = cfg.replace(
+            optimizer=dataclasses.replace(cfg.optimizer, **opt_updates))
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.list_configs:
+        from distributeddeeplearning_tpu import config as cfglib
+        print("\n".join(cfglib.PRESETS))
+        return 0
+
+    import os
+    if args.backend == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    cfg = build_config(args)
+    from distributeddeeplearning_tpu.train import loop
+
+    from distributeddeeplearning_tpu.models import model_spec
+
+    total_steps = args.steps
+    if total_steps is None:
+        if model_spec(cfg.model).input_kind == "tokens":
+            # MLM pretraining is step-based (no canonical "epoch"); require
+            # an explicit step budget rather than inventing one.
+            raise SystemExit(
+                "token models have no epoch semantics; pass --steps")
+        steps_per_epoch = cfg.steps_per_epoch or (
+            1_281_167 // cfg.global_batch_size)  # ImageNet train split
+        total_steps = int(cfg.num_epochs * steps_per_epoch)
+
+    summary = loop.run(cfg, total_steps=total_steps,
+                       warmup_steps=min(args.warmup_steps, total_steps - 1)
+                       if total_steps > 1 else 0)
+    print(json.dumps({"summary": summary}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

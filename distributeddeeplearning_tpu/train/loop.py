@@ -22,6 +22,7 @@ from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.train import checkpoint as ckptlib
 from distributeddeeplearning_tpu.train import optim, steps
 from distributeddeeplearning_tpu.train.state import TrainState
 from distributeddeeplearning_tpu.utils.logging import MetricLogger
@@ -91,28 +92,70 @@ def build(config: TrainConfig, total_steps: int):
 
 def run(config: TrainConfig, *, total_steps: int,
         logger: Optional[MetricLogger] = None,
-        warmup_steps: int = 0) -> dict[str, Any]:
+        warmup_steps: int = 0, eval_batches: int = 0,
+        return_state: bool = False) -> dict[str, Any]:
     """Train for ``total_steps``; returns a summary with throughput.
 
     ``warmup_steps`` are excluded from timing (compile + first-step cost),
     matching the reference benchmark harness semantics (SURVEY.md §3.4).
+    With ``config.checkpoint_dir`` set, saves every
+    ``checkpoint_every_steps`` (async) plus a final save, and — when
+    ``config.resume`` — restores the newest checkpoint and continues from
+    its step, replaying the deterministic data stream from there.
+    ``eval_batches > 0`` runs a sharded top-1 eval after training
+    (SURVEY.md §3.5) on image models.
     """
     logger = logger or MetricLogger()
+    spec = model_spec(config.model)
+    if eval_batches > 0 and spec.input_kind != "image":
+        raise ValueError(
+            "eval_batches (top-1 eval) only applies to image models; "
+            f"{config.model!r} is a {spec.input_kind!r} model")
     mesh, model, source, state, train_step, sched, rng = build(
         config, total_steps)
+
+    ckpt = ckptlib.Checkpointer.create(config)
+    try:
+        return _run_inner(
+            config, spec, mesh, model, source, state, train_step, sched,
+            rng, ckpt, logger, total_steps=total_steps,
+            warmup_steps=warmup_steps, eval_batches=eval_batches,
+            return_state=return_state)
+    finally:
+        if ckpt is not None:
+            ckpt.close()  # releases the async-checkpointing executor
+
+
+def _run_inner(config, spec, mesh, model, source, state, train_step, sched,
+               rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
+               return_state) -> dict[str, Any]:
+    start_step = 0
+    if ckpt is not None and config.resume:
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+    # A resumed run may have fewer than warmup_steps left to execute (or
+    # none at all, when the checkpoint already passed total_steps).
+    warmup_steps = min(warmup_steps, max(total_steps - start_step - 1, 0))
+    end_step = max(total_steps, start_step)
+
     if jax.process_index() == 0:
         # stderr so harness consumers (bench.py) keep a clean stdout
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
-              f"dtype={config.dtype}", file=sys.stderr, flush=True)
+              f"dtype={config.dtype}"
+              + (f" | resumed@{start_step}" if start_step else ""),
+              file=sys.stderr, flush=True)
 
     metrics = {}
     timed_examples = 0
     # warmup_steps == 0 means "time everything" (incl. compile).
     t_timed = time.perf_counter() if warmup_steps == 0 else None
-    for i in range(total_steps):
+    for i in range(start_step, total_steps):
         state, metrics = train_step(state, source.batch(i), rng)
-        if i + 1 == warmup_steps:
+        done = i - start_step + 1
+        if done == warmup_steps:
             jax.block_until_ready(metrics)
             t_timed = time.perf_counter()
         if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
@@ -120,12 +163,20 @@ def run(config: TrainConfig, *, total_steps: int,
             logger.log(int(i + 1), metrics,
                        examples_per_step=config.global_batch_size,
                        lr=float(sched(i)))
-        if i >= warmup_steps:
+        if done > warmup_steps:
             timed_examples += config.global_batch_size
+        if ckpt is not None:
+            ckpt.maybe_save(i + 1, state)
 
     jax.block_until_ready(state)
+    if ckpt is not None:
+        if total_steps > start_step:
+            ckpt.maybe_save(total_steps, state, force=True)
+        ckpt.wait()
+
     summary: dict[str, Any] = {
-        "final_step": total_steps,
+        "final_step": end_step,
+        "start_step": start_step,
         "final_metrics": {k: float(v) for k, v in metrics.items()},
     }
     if t_timed is not None and timed_examples:
@@ -133,5 +184,31 @@ def run(config: TrainConfig, *, total_steps: int,
         summary["examples_per_sec"] = timed_examples / elapsed
         summary["examples_per_sec_per_chip"] = (
             summary["examples_per_sec"] / jax.device_count())
-        summary["steps_per_sec"] = (total_steps - warmup_steps) / elapsed
+        summary["steps_per_sec"] = (
+            total_steps - start_step - warmup_steps) / elapsed
+    if eval_batches > 0 and spec.input_kind == "image":
+        # Offset past every batch any run of this config has trained on.
+        summary["eval_top1"] = evaluate(
+            config, mesh, model, state, source, eval_batches,
+            first_step=end_step)
+    if return_state:
+        summary["state"] = state
     return summary
+
+
+def evaluate(config: TrainConfig, mesh, model, state, source,
+             num_batches: int, *, first_step: int = 0) -> float:
+    """Sharded top-1 over ``num_batches``: per-shard correct counts are
+    psummed across the DP axes before dividing (SURVEY.md §3.5), so the
+    result is identical to a single-device pass over the global batch.
+
+    ``first_step`` offsets the deterministic source so eval batches don't
+    replay training batches.
+    """
+    eval_step = steps.make_dp_eval_step(model, mesh, config)
+    correct = total = 0
+    for j in range(num_batches):
+        counts = eval_step(state, source.batch(first_step + j))
+        correct += int(jax.device_get(counts["correct"]))
+        total += int(jax.device_get(counts["total"]))
+    return correct / max(total, 1)

@@ -49,6 +49,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--warmup-steps", type=int, default=2,
                    help="steps excluded from throughput timing")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--eval-batches", type=int, default=0,
+                   help="run sharded top-1 eval over N batches after training")
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore existing checkpoints in --checkpoint-dir")
     return p.parse_args(argv)
 
 
@@ -70,6 +74,8 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(log_every=args.log_every)
     if args.checkpoint_dir:
         cfg = cfg.replace(checkpoint_dir=args.checkpoint_dir)
+    if args.no_resume:
+        cfg = cfg.replace(resume=False)
     cfg = cfg.replace(backend=args.backend)
 
     par = cfg.parallel
@@ -135,7 +141,8 @@ def main(argv=None) -> int:
 
     summary = loop.run(cfg, total_steps=total_steps,
                        warmup_steps=min(args.warmup_steps, total_steps - 1)
-                       if total_steps > 1 else 0)
+                       if total_steps > 1 else 0,
+                       eval_batches=args.eval_batches)
     print(json.dumps({"summary": summary}), flush=True)
     return 0
 

@@ -1,10 +1,42 @@
 """Input pipelines: the TPU-native replacement for the reference's CUDA/DALI
 loaders (BASELINE.json:5 — "grain/tf.data pipelines with device-side HBM
 prefetch"). Synthetic mode (SURVEY.md §2 #5) generates batches on-device for
-data-independent benchmarking (config 1)."""
+data-independent benchmarking (config 1); real ImageNet rides tf.data's C++
+op threads (data/imagenet.py) or the in-tree native C++ loader
+(data/native.py)."""
 
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.data.synthetic import (  # noqa: F401
     SyntheticImages,
     SyntheticTokens,
-    make_source,
 )
+
+
+def make_source(config: TrainConfig, input_kind: str,
+                sharding: Optional[jax.sharding.Sharding] = None, *,
+                start_step: int = 0, train: bool = True):
+    """Route to the right pipeline for ``config.data``.
+
+    - synthetic (or no data_dir): on-device deterministic batches, indexable
+      by step — resume needs no skipping;
+    - image + data_dir: tf.data ImageNet (TFRecord or image-folder layout)
+      sharded per process, streamed from ``start_step``;
+    - tokens + data_dir: packed-token MLM pipeline (data/tokens.py).
+    """
+    d = config.data
+    if d.synthetic or not d.data_dir:
+        return synthetic.make_source(config, input_kind, sharding=sharding)
+    if input_kind == "tokens":
+        from distributeddeeplearning_tpu.data import tokens
+        return tokens.make_token_source(
+            config, sharding, start_step=start_step, train=train)
+    from distributeddeeplearning_tpu.data import imagenet
+    return imagenet.make_imagenet_source(
+        config, sharding, train=train, start_step=start_step)

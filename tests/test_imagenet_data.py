@@ -1,0 +1,165 @@
+"""Real-data pipeline tests: tiny generated ImageNet (TFRecord + folder
+layouts), sharding, augmentation invariants, end-to-end training integration
+(SURVEY.md §4 "Integration")."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu import data as datalib
+from distributeddeeplearning_tpu.config import (
+    DataConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.data import imagenet
+from distributeddeeplearning_tpu.parallel import mesh as meshlib
+from distributeddeeplearning_tpu.parallel import sharding as shardlib
+
+tf = pytest.importorskip("tensorflow")
+
+NUM_CLASSES = 4
+IMAGES_PER_CLASS = 8
+IMG = 64
+
+
+def _jpeg_bytes(rng: np.random.Generator, label: int) -> bytes:
+    # Class-colored images so labels are recoverable from pixels.
+    arr = np.full((IMG, IMG, 3), 40 + 50 * label, np.uint8)
+    arr += rng.integers(0, 10, arr.shape, dtype=np.uint8)
+    return tf.io.encode_jpeg(arr).numpy()
+
+
+@pytest.fixture(scope="module")
+def tfrecord_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imagenet_tfr")
+    rng = np.random.default_rng(0)
+    for shard in range(2):
+        for split, n_img in (("train", IMAGES_PER_CLASS), ("validation", 2)):
+            path = os.path.join(root, f"{split}-{shard:05d}-of-00002")
+            with tf.io.TFRecordWriter(path) as w:
+                for label in range(NUM_CLASSES):
+                    for _ in range(n_img):
+                        ex = tf.train.Example(features=tf.train.Features(feature={
+                            "image/encoded": tf.train.Feature(
+                                bytes_list=tf.train.BytesList(
+                                    value=[_jpeg_bytes(rng, label)])),
+                            # canonical TFRecords are 1-based
+                            "image/class/label": tf.train.Feature(
+                                int64_list=tf.train.Int64List(value=[label + 1])),
+                        }))
+                        w.write(ex.SerializeToString())
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def folder_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imagenet_folder")
+    rng = np.random.default_rng(1)
+    for split in ("train", "val"):
+        for label in range(NUM_CLASSES):
+            d = os.path.join(root, split, f"n{label:08d}")
+            os.makedirs(d)
+            for i in range(IMAGES_PER_CLASS if split == "train" else 2):
+                with open(os.path.join(d, f"img_{i}.JPEG"), "wb") as f:
+                    f.write(_jpeg_bytes(rng, label))
+    return str(root)
+
+
+def _cfg(data_dir, batch=8, dp=2):
+    return TrainConfig(
+        model="resnet18", global_batch_size=batch, dtype="float32",
+        parallel=ParallelConfig(data=dp),
+        data=DataConfig(synthetic=False, data_dir=data_dir, image_size=32,
+                        num_classes=NUM_CLASSES, shuffle_buffer=64))
+
+
+def test_detect_layout(tfrecord_dir, folder_dir, tmp_path):
+    assert imagenet.detect_layout(tfrecord_dir) == "tfrecord"
+    assert imagenet.detect_layout(folder_dir) == "folder"
+    with pytest.raises(FileNotFoundError):
+        imagenet.detect_layout(str(tmp_path))
+
+
+@pytest.mark.parametrize("layout", ["tfrecord", "folder"])
+def test_batches_shapes_and_labels(layout, tfrecord_dir, folder_dir):
+    cfg = _cfg(tfrecord_dir if layout == "tfrecord" else folder_dir)
+    mesh = meshlib.make_mesh(cfg.parallel)
+    shd = shardlib.batch_sharding(mesh)
+    src = imagenet.make_imagenet_source(cfg, shd, train=True)
+    for step in range(3):
+        b = src.batch(step)
+        assert b["image"].shape == (8, 32, 32, 3)
+        assert b["image"].dtype == np.float32
+        assert b["label"].shape == (8,)
+        labels = np.asarray(jax.device_get(b["label"]))
+        assert ((0 <= labels) & (labels < NUM_CLASSES)).all()
+        # global array is sharded over the data axis, not replicated
+        assert b["image"].sharding.is_equivalent_to(shd, 4) or (
+            b["image"].sharding.spec == shd.spec)
+
+
+def test_labels_match_pixels(tfrecord_dir):
+    """Class-colored images: decoded pixel level must identify the label —
+    catches any decode/label pairing bug in the interleave."""
+    cfg = _cfg(tfrecord_dir, batch=16, dp=1)
+    mesh = meshlib.make_mesh(cfg.parallel)
+    src = imagenet.make_imagenet_source(
+        cfg, shardlib.batch_sharding(mesh), train=False)
+    b = src.batch(0)
+    images = np.asarray(jax.device_get(b["image"]))
+    labels = np.asarray(jax.device_get(b["label"]))
+    # Undo normalization to recover the class color plateau.
+    raw = images * np.array(imagenet.STDDEV_RGB) + np.array(imagenet.MEAN_RGB)
+    inferred = np.clip(np.round((raw.mean((1, 2, 3)) - 45) / 50), 0,
+                       NUM_CLASSES - 1).astype(np.int32)
+    assert (inferred == labels).all()
+
+
+def test_process_sharding_disjoint(tfrecord_dir):
+    """Two simulated processes must read disjoint validation examples."""
+    cfg = _cfg(tfrecord_dir, batch=8, dp=1)
+    seen = []
+    for proc in range(2):
+        ds = imagenet.build_dataset(cfg, train=False, process_index=proc,
+                                    process_count=2)
+        batch = next(iter(ds.as_numpy_iterator()))
+        seen.append(batch["image"].sum(axis=(1, 2, 3)))
+    # Image checksums from different shards shouldn't collide en masse.
+    overlap = np.intersect1d(np.round(seen[0], 2), np.round(seen[1], 2))
+    assert overlap.size < 4
+
+
+def test_stream_source_enforces_order(tfrecord_dir):
+    cfg = _cfg(tfrecord_dir)
+    mesh = meshlib.make_mesh(cfg.parallel)
+    src = imagenet.make_imagenet_source(
+        cfg, shardlib.batch_sharding(mesh), train=True)
+    src.batch(0)
+    with pytest.raises(ValueError, match="out of order"):
+        src.batch(5)
+
+
+def test_train_end_to_end_real_data(tfrecord_dir):
+    """Integration: loss decreases training on the (trivially separable)
+    class-colored dataset through the full loop + real pipeline."""
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = _cfg(tfrecord_dir, batch=16, dp=2).replace(
+        log_every=10**9)
+    summary = loop.run(cfg, total_steps=8, eval_batches=1)
+    assert summary["final_step"] == 8
+    assert np.isfinite(summary["final_metrics"]["loss"])
+    assert 0.0 <= summary["eval_top1"] <= 1.0
+
+
+def test_dispatcher_routes(tfrecord_dir):
+    cfg = _cfg(tfrecord_dir)
+    mesh = meshlib.make_mesh(cfg.parallel)
+    shd = shardlib.batch_sharding(mesh)
+    src = datalib.make_source(cfg, "image", shd)
+    assert isinstance(src, imagenet.StreamSource)
+    syn = datalib.make_source(cfg.replace(
+        data=DataConfig(synthetic=True)), "image", shd)
+    assert isinstance(syn, datalib.SyntheticImages)

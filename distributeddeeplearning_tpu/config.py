@@ -101,6 +101,7 @@ class TrainConfig:
     checkpoint_every_steps: int = 5000
     resume: bool = True
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
+    profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

@@ -161,25 +161,30 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
 
     metrics = {}
     timed_examples = 0
+    profile = _Profiler(config)
     # warmup_steps == 0 means "time everything" (incl. compile).
     t_timed = time.perf_counter() if warmup_steps == 0 else None
-    for i in range(start_step, total_steps):
-        state, metrics = train_step(state, source.batch(i), rng)
-        done = i - start_step + 1
-        if done == warmup_steps:
-            jax.block_until_ready(metrics)
-            t_timed = time.perf_counter()
-        if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
-            jax.block_until_ready(metrics)
-            logger.log(int(i + 1), metrics,
-                       examples_per_step=config.global_batch_size,
-                       lr=float(sched(i)))
-        if done > warmup_steps:
-            timed_examples += config.global_batch_size
-        if ckpt is not None:
-            ckpt.maybe_save(i + 1, state)
-
-    jax.block_until_ready(state)
+    try:
+        for i in range(start_step, total_steps):
+            profile.before_step(i)
+            state, metrics = train_step(state, source.batch(i), rng)
+            profile.after_step(i, metrics)
+            done = i - start_step + 1
+            if done == warmup_steps:
+                jax.block_until_ready(metrics)
+                t_timed = time.perf_counter()
+            if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
+                jax.block_until_ready(metrics)
+                logger.log(int(i + 1), metrics,
+                           examples_per_step=config.global_batch_size,
+                           lr=float(sched(i)))
+            if done > warmup_steps:
+                timed_examples += config.global_batch_size
+            if ckpt is not None:
+                ckpt.maybe_save(i + 1, state)
+        jax.block_until_ready(state)
+    finally:
+        profile.finish()
     if ckpt is not None:
         if total_steps > start_step:
             ckpt.maybe_save(total_steps, state, force=True)
@@ -204,6 +209,45 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if return_state:
         summary["state"] = state
     return summary
+
+
+class _Profiler:
+    """Hot-loop tracing hook (SURVEY.md §5.1) — the TPU replacement for
+    Horovod's HOROVOD_TIMELINE Chrome trace. ``config.profile_steps=(a, b)``
+    captures a ``jax.profiler`` trace of steps [a, b) into
+    ``config.profile_dir`` (TensorBoard-loadable), process 0 only."""
+
+    def __init__(self, config: TrainConfig):
+        self.span = config.profile_steps
+        self.dir = config.profile_dir or "/tmp/ddl_tpu_profile"
+        self.active = False
+        self.enabled = self.span is not None and jax.process_index() == 0
+
+    def before_step(self, step: int) -> None:
+        if not self.enabled:
+            return
+        lo, hi = self.span
+        if not self.active and lo <= step < hi:
+            jax.profiler.start_trace(self.dir)
+            self.active = True
+
+    def after_step(self, step: int, metrics) -> None:
+        # Stop only after the last profiled step's device work completes —
+        # dispatch is async, so stopping without blocking would trace host
+        # activity only.
+        if self.active and step + 1 >= self.span[1]:
+            jax.block_until_ready(metrics)
+            self._stop()
+
+    def finish(self) -> None:
+        if self.active:
+            self._stop()
+
+    def _stop(self) -> None:
+        jax.profiler.stop_trace()
+        self.active = False
+        print(f"# profiler trace written to {self.dir}",
+              file=sys.stderr, flush=True)
 
 
 def evaluate(config: TrainConfig, mesh, model, state, batch_shd,

@@ -56,6 +56,17 @@ def test_metric_logger_jsonl(tmp_path):
     assert "examples_per_sec" in lines[1]
 
 
+def test_profiler_trace_capture(tmp_path):
+    """profile_steps=(1,2) writes a jax.profiler trace dir (SURVEY.md §5.1)."""
+    cfg = TrainConfig(model="resnet18", global_batch_size=8, dtype="float32",
+                      log_every=10**9,
+                      profile_steps=(1, 2), profile_dir=str(tmp_path / "prof"),
+                      data=DataConfig(image_size=32, num_classes=10))
+    loop.run(cfg, total_steps=3, logger=MetricLogger(enabled=False))
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in produced), produced
+
+
 @pytest.mark.slow
 def test_train_cli_smoke():
     """End-to-end CLI run on the CPU backend (subprocess, tiny workload)."""

@@ -53,6 +53,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="run sharded top-1 eval over N batches after training")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore existing checkpoints in --checkpoint-dir")
+    p.add_argument("--profile-steps", default=None, metavar="A,B",
+                   help="capture a jax.profiler trace of steps [A,B)")
+    p.add_argument("--profile-dir", default=None,
+                   help="trace output dir (default /tmp/ddl_tpu_profile)")
     return p.parse_args(argv)
 
 
@@ -77,6 +81,18 @@ def build_config(args: argparse.Namespace):
     if args.no_resume:
         cfg = cfg.replace(resume=False)
     cfg = cfg.replace(backend=args.backend)
+    if args.profile_steps:
+        try:
+            lo, hi = (int(x) for x in args.profile_steps.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--profile-steps expects A,B (got {args.profile_steps!r})")
+        if not 0 <= lo < hi:
+            raise SystemExit(
+                f"--profile-steps needs 0 <= A < B (got {lo},{hi})")
+        cfg = cfg.replace(profile_steps=(lo, hi))
+    if args.profile_dir:
+        cfg = cfg.replace(profile_dir=args.profile_dir)
 
     par = cfg.parallel
     updates = {}

@@ -102,6 +102,7 @@ class TrainConfig:
     resume: bool = True
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
+    fail_at_step: Optional[int] = None  # fault injection (SURVEY.md §5.3)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

@@ -1,0 +1,218 @@
+"""Pod-slice launcher — the TPU-native replacement for mpirun / Batch-AI.
+
+The reference launched N ranks with ``mpirun`` under a Batch-AI job and let
+MPI handle rendezvous (SURVEY.md §2 #9-#10, §3.1). On TPU the moral
+equivalents are:
+
+- **rendezvous**: ``jax.distributed.initialize(coordinator, num_processes,
+  process_id)`` — replaces ``MPI_Init``; XLA then sees the global device set.
+- **process placement**: one Python process per TPU host. On Cloud TPU pod
+  slices the TPU runtime supplies topology env vars and
+  ``jax.distributed.initialize()`` needs no arguments; everywhere else (and
+  for local multi-process development on CPU) this module wires the
+  coordinator explicitly through ``DDL_*`` env vars.
+- **failure detection** (SURVEY.md §5.3): the reference's mpirun died whole
+  when any rank died. ``monitor`` reproduces that for the processes this
+  launcher owns: first local child to exit nonzero triggers terminate-all
+  and a nonzero launcher exit, so a wrapper can restart the job from the
+  last checkpoint (fail-whole + checkpoint-resume semantics). Across hosts
+  (``--hostfile``), each host's launcher only sees its own child; a *remote*
+  rank's death reaches the survivors through jax.distributed's coordinator
+  heartbeat, which tears down their processes — the local launcher then
+  reports that nonzero exit. Cross-host detection latency is therefore the
+  heartbeat timeout, not this monitor's poll interval.
+
+Usage (local dev, 2 simulated hosts on CPU):
+    python launch.py --num-processes 2 -- python train.py --backend cpu ...
+
+Usage (TPU pod slice, run on every host, e.g. via gcloud ssh --worker=all):
+    python launch.py -- python train.py --backend tpu ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+ENV_COORDINATOR = "DDL_COORDINATOR"
+ENV_NUM_PROCESSES = "DDL_NUM_PROCESSES"
+ENV_PROCESS_ID = "DDL_PROCESS_ID"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessSpec:
+    """One training process in the job (≈ one MPI rank, one TPU host)."""
+
+    process_id: int
+    num_processes: int
+    coordinator: str  # "host:port"
+
+    def env(self) -> dict[str, str]:
+        return {
+            ENV_COORDINATOR: self.coordinator,
+            ENV_NUM_PROCESSES: str(self.num_processes),
+            ENV_PROCESS_ID: str(self.process_id),
+        }
+
+
+def plan_local(num_processes: int, *, port: int = 9531,
+               coordinator_host: str = "127.0.0.1") -> list[ProcessSpec]:
+    """Specs for N processes on this machine (multi-host simulation)."""
+    coord = f"{coordinator_host}:{port}"
+    return [ProcessSpec(i, num_processes, coord) for i in range(num_processes)]
+
+
+def plan_from_hostfile(path: str, *, port: int = 9531) -> list[ProcessSpec]:
+    """Specs from a one-host-per-line file (first host is coordinator) —
+    the launcher-side analogue of an MPI hostfile. Each host runs the
+    launcher with ``--process-id`` matching its line number."""
+    with open(path) as f:
+        hosts = [ln.strip() for ln in f if ln.strip()
+                 and not ln.lstrip().startswith("#")]
+    if not hosts:
+        raise ValueError(f"hostfile {path!r} lists no hosts")
+    coord = f"{hosts[0]}:{port}"
+    return [ProcessSpec(i, len(hosts), coord) for i in range(len(hosts))]
+
+
+def maybe_initialize_distributed() -> Optional[int]:
+    """Called by train.py at startup. Joins the job if one is configured.
+
+    Returns the process id when distributed was initialized, else None.
+    Resolution order:
+    1. ``DDL_*`` env vars (set by this launcher) → explicit initialize;
+    2. Cloud TPU pod-slice env (multi-host libtpu topology) → argless
+       initialize, deferring to the TPU runtime's own metadata;
+    3. otherwise single-process: do nothing.
+    """
+    import jax
+
+    if os.environ.get(ENV_COORDINATOR):
+        spec = ProcessSpec(
+            process_id=int(os.environ[ENV_PROCESS_ID]),
+            num_processes=int(os.environ[ENV_NUM_PROCESSES]),
+            coordinator=os.environ[ENV_COORDINATOR])
+        jax.distributed.initialize(
+            coordinator_address=spec.coordinator,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id)
+        return spec.process_id
+    # Cloud TPU pod slice: the runtime's own topology env lists >1 worker
+    # host; defer entirely to it. (A 1-host listing — also what this dev
+    # image sets — is single-process and needs no rendezvous.)
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len(workers.split(",")) > 1:
+        jax.distributed.initialize()
+        return jax.process_index()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Child spawn + monitoring (fail-whole semantics)
+# ---------------------------------------------------------------------------
+
+def spawn(spec: ProcessSpec, command: Sequence[str], *,
+          extra_env: Optional[dict[str, str]] = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update(spec.env())
+    env.update(extra_env or {})
+    return subprocess.Popen(list(command), env=env)
+
+
+def monitor(children: Sequence[subprocess.Popen], *,
+            poll_interval_s: float = 0.2,
+            grace_s: float = 10.0) -> int:
+    """Wait for all children; kill the survivors as soon as one fails.
+
+    Returns 0 iff every child exited 0 — the contract a restart wrapper
+    checks before deciding to relaunch from the last checkpoint.
+    """
+    procs = list(children)
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                _terminate_all(procs, grace_s)
+                return int(failed[0]) or 1
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(poll_interval_s)
+    except KeyboardInterrupt:
+        _terminate_all(procs, grace_s)
+        return 130
+
+
+def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def run_local(num_processes: int, command: Sequence[str], *,
+              port: int = 9531) -> int:
+    """Spawn + monitor N local processes (the `mpirun -np N` replacement)."""
+    specs = plan_local(num_processes, port=port)
+    children = [spawn(s, command) for s in specs]
+    return monitor(children)
+
+
+def run_from_hostfile(path: str, process_id: int, command: Sequence[str], *,
+                      port: int = 9531) -> int:
+    """Run this host's single process of a hostfile-defined job."""
+    specs = plan_from_hostfile(path, port=port)
+    if not 0 <= process_id < len(specs):
+        raise ValueError(
+            f"process_id {process_id} out of range for {len(specs)} hosts")
+    child = spawn(specs[process_id], command)
+    return monitor([child])
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="spawn N local processes (multi-host simulation / "
+                        "single-host multi-process)")
+    p.add_argument("--hostfile", default=None,
+                   help="one host per line; first is coordinator")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this host's line number in --hostfile")
+    p.add_argument("--port", type=int, default=9531,
+                   help="coordinator port")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command, after `--`")
+    args = p.parse_args(argv)
+
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        p.error("no training command given (pass it after `--`)")
+
+    if args.hostfile:
+        if args.process_id is None:
+            p.error("--hostfile requires --process-id")
+        return run_from_hostfile(args.hostfile, args.process_id, command,
+                                 port=args.port)
+    n = args.num_processes or 1
+    return run_local(n, command, port=args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,6 +134,10 @@ def run(config: TrainConfig, *, total_steps: int,
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
                return_state) -> dict[str, Any]:
+    if config.fail_at_step is not None and config.fail_at_step > total_steps:
+        raise ValueError(
+            f"fail_at_step={config.fail_at_step} is beyond "
+            f"total_steps={total_steps}; the injected fault would never fire")
     start_step = 0
     if ckpt is not None and config.resume:
         restored = ckpt.restore_latest(state)
@@ -182,6 +186,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 timed_examples += config.global_batch_size
             if ckpt is not None:
                 ckpt.maybe_save(i + 1, state)
+            if config.fail_at_step is not None and i + 1 == config.fail_at_step:
+                # Fault injection (SURVEY.md §5.3): die like a preempted host
+                # so the launcher's fail-whole path + checkpoint-resume get
+                # exercised end-to-end.
+                if ckpt is not None:
+                    ckpt.wait()
+                raise SystemExit(
+                    f"fault injection: killed after step {i + 1}")
         jax.block_until_ready(state)
     finally:
         profile.finish()

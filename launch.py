@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Pod-slice launcher CLI — replaces the reference's mpirun/Batch-AI job
+submission (SURVEY.md §2 #10). See distributeddeeplearning_tpu/launch.py.
+
+    python launch.py --num-processes 2 -- python train.py --backend cpu ...
+"""
+
+import sys
+
+from distributeddeeplearning_tpu import launch
+
+if __name__ == "__main__":
+    sys.exit(launch.main())

@@ -1,0 +1,106 @@
+"""Launcher tests (SURVEY.md §2 #9-#10, §5.3): host planning, fail-whole
+monitoring, multi-process rendezvous, and fault-injection → resume.
+
+Real pod-slice runs are manual/benchmark-time (SURVEY.md §4); here the
+process-management layer is tested with local subprocesses, exactly how the
+launcher simulates a multi-host job on one machine.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributeddeeplearning_tpu import launch
+
+
+def test_plan_local():
+    specs = launch.plan_local(4, port=9100)
+    assert [s.process_id for s in specs] == [0, 1, 2, 3]
+    assert all(s.num_processes == 4 for s in specs)
+    assert all(s.coordinator == "127.0.0.1:9100" for s in specs)
+    env = specs[2].env()
+    assert env[launch.ENV_PROCESS_ID] == "2"
+    assert env[launch.ENV_NUM_PROCESSES] == "4"
+
+
+def test_plan_from_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# slice hosts\nworker0\nworker1\n\nworker2\n")
+    specs = launch.plan_from_hostfile(str(hf), port=9200)
+    assert len(specs) == 3
+    assert specs[0].coordinator == "worker0:9200"  # first host coordinates
+    assert specs[2].process_id == 2
+    empty = tmp_path / "empty"
+    empty.write_text("# comments only\n")
+    with pytest.raises(ValueError):
+        launch.plan_from_hostfile(str(empty))
+
+
+def _spawn_py(code: str) -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, "-c", code])
+
+
+def test_monitor_all_succeed():
+    children = [_spawn_py("import sys; sys.exit(0)") for _ in range(3)]
+    assert launch.monitor(children) == 0
+
+
+def test_monitor_fail_whole():
+    """First nonzero exit kills the survivors (mpirun semantics)."""
+    slow = _spawn_py("import time; time.sleep(60)")
+    bad = _spawn_py("import sys; sys.exit(3)")
+    rc = launch.monitor([slow, bad], poll_interval_s=0.05, grace_s=5.0)
+    assert rc == 3
+    assert slow.poll() is not None  # terminated, not left running
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous():
+    """launch.run_local really wires jax.distributed: both processes must see
+    num_processes=2 and the global device count."""
+    code = (
+        "import os\n"
+        "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from distributeddeeplearning_tpu import launch\n"
+        "pid = launch.maybe_initialize_distributed()\n"
+        "import jax\n"
+        "assert pid == jax.process_index(), (pid, jax.process_index())\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "assert jax.device_count() == 2 * jax.local_device_count()\n"
+    )
+    specs = launch.plan_local(2, port=9310)
+    # XLA_FLAGS="" overrides the suite's 8-fake-device flag: 1 local CPU
+    # device per process.
+    children = [launch.spawn(s, [sys.executable, "-c", code],
+                             extra_env={"XLA_FLAGS": ""}) for s in specs]
+    assert launch.monitor(children, poll_interval_s=0.1) == 0
+
+
+@pytest.mark.slow
+def test_fault_injection_then_resume(tmp_path):
+    """End-to-end §5.3 story: a run killed at step 3 exits nonzero through
+    the launcher; the relaunch resumes from the step-2 checkpoint."""
+    ckpt = str(tmp_path / "ckpt")
+    base = [sys.executable, "train.py", "--backend", "cpu", "--model",
+            "resnet18", "--batch-size", "8", "--dp", "1", "--synthetic",
+            "--dtype", "float32", "--steps", "5", "--checkpoint-dir", ckpt,
+            "--checkpoint-every", "2", "--log-every", "1000000"]
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+
+    crash = subprocess.run(
+        [sys.executable, "launch.py", "--num-processes", "1", "--"]
+        + base + ["--fail-at-step", "3"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert crash.returncode != 0
+    assert "fault injection" in crash.stderr
+
+    resume = subprocess.run(base, capture_output=True, text=True,
+                            timeout=600, env=env)
+    assert resume.returncode == 0, resume.stderr[-2000:]
+    import json
+    summary = json.loads(resume.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["start_step"] == 2  # resumed from the step-2 checkpoint
+    assert summary["final_step"] == 5

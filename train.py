@@ -57,6 +57,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="capture a jax.profiler trace of steps [A,B)")
     p.add_argument("--profile-dir", default=None,
                    help="trace output dir (default /tmp/ddl_tpu_profile)")
+    p.add_argument("--fail-at-step", type=int, default=None,
+                   help="fault injection: crash after completing step K "
+                        "(exercises checkpoint-resume; SURVEY.md §5.3)")
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="save a checkpoint every N steps")
     return p.parse_args(argv)
 
 
@@ -80,6 +85,16 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(checkpoint_dir=args.checkpoint_dir)
     if args.no_resume:
         cfg = cfg.replace(resume=False)
+    if args.fail_at_step is not None:
+        if args.fail_at_step <= 0:
+            raise SystemExit(
+                f"--fail-at-step must be positive (got {args.fail_at_step})")
+        cfg = cfg.replace(fail_at_step=args.fail_at_step)
+    if args.checkpoint_every is not None:
+        if args.checkpoint_every <= 0:
+            raise SystemExit(
+                f"--checkpoint-every must be positive (got {args.checkpoint_every})")
+        cfg = cfg.replace(checkpoint_every_steps=args.checkpoint_every)
     cfg = cfg.replace(backend=args.backend)
     if args.profile_steps:
         try:
@@ -139,6 +154,11 @@ def main(argv=None) -> int:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    # Join a multi-host job if the launcher (launch.py) configured one —
+    # the MPI_Init moment of the reference's stack (SURVEY.md §3.1).
+    from distributeddeeplearning_tpu import launch as launchlib
+    launchlib.maybe_initialize_distributed()
+
     cfg = build_config(args)
     from distributeddeeplearning_tpu.train import loop
 
@@ -159,7 +179,9 @@ def main(argv=None) -> int:
                        warmup_steps=min(args.warmup_steps, total_steps - 1)
                        if total_steps > 1 else 0,
                        eval_batches=args.eval_batches)
-    print(json.dumps({"summary": summary}), flush=True)
+    import jax
+    if jax.process_index() == 0:
+        print(json.dumps({"summary": summary}), flush=True)
     return 0
 
 

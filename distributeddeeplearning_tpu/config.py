@@ -103,6 +103,7 @@ class TrainConfig:
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
     fail_at_step: Optional[int] = None  # fault injection (SURVEY.md §5.3)
+    attention_impl: Optional[str] = None  # None=model default; dense | ring
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
@@ -153,6 +154,15 @@ def preset(name: str) -> TrainConfig:
             optimizer=OptimizerConfig(
                 name="adamw", learning_rate=1e-4, weight_decay=0.01,
                 schedule="linear", warmup_epochs=0.0, label_smoothing=0.0))
+    if name == "bert_base_mlm_longctx":   # long-context: ring attention over
+        return TrainConfig(               # the seq axis (SURVEY.md §5.7)
+            model="bert_base", global_batch_size=32,
+            parallel=ParallelConfig(data=2, seq=4),
+            attention_impl="ring",
+            data=DataConfig(dataset="mlm", seq_len=2048),
+            optimizer=OptimizerConfig(
+                name="adamw", learning_rate=1e-4, weight_decay=0.01,
+                schedule="linear", warmup_epochs=0.0, label_smoothing=0.0))
     if name == "resnet50_lars_32k":       # config 5
         return TrainConfig(
             model="resnet50", global_batch_size=32768, dtype="bfloat16",
@@ -168,5 +178,5 @@ def preset(name: str) -> TrainConfig:
 
 PRESETS = (
     "resnet50_synthetic", "resnet50_dp", "resnet152_dp", "densenet121_dp",
-    "bert_base_mlm", "resnet50_lars_32k",
+    "bert_base_mlm", "bert_base_mlm_longctx", "resnet50_lars_32k",
 )

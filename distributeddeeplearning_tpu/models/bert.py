@@ -33,6 +33,12 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     layer_norm_eps: float = 1e-12
+    # "dense": materialized (S, S) scores, XLA-fused — right for short seqs.
+    # "ring": blockwise ring attention over the `seq` mesh axis
+    #   (parallel/ring_attention.py) — O(S_local) memory, exact, long-context.
+    # Like flash kernels, "ring" skips attention-probability dropout (the
+    # probs are never materialized); all other dropouts apply unchanged.
+    attention_impl: str = "dense"
 
 
 def _dense(features, logical_axes, name, dtype, use_bias=True):
@@ -61,15 +67,27 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
-        scale = head_dim ** -0.5
-        # (B, heads, S, S) scores — contiguous MXU matmuls via einsum.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        if mask is not None:
-            big_neg = jnp.finfo(jnp.float32).min
-            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-        probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
-        probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=deterministic)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        if cfg.attention_impl == "ring":
+            from distributeddeeplearning_tpu.parallel import ring_attention
+            kv_mask = (jnp.ones((b, s), jnp.bool_) if mask is None
+                       else mask.astype(jnp.bool_))
+            out = ring_attention.ring_attention_sharded(
+                q, k, v, kv_mask).reshape(b, s, -1)
+        elif cfg.attention_impl == "dense":
+            scale = head_dim ** -0.5
+            # (B, heads, S, S) scores — contiguous MXU matmuls via einsum.
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if mask is not None:
+                big_neg = jnp.finfo(jnp.float32).min
+                scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+            probs = nn.softmax(
+                scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+            probs = nn.Dropout(cfg.dropout_rate)(
+                probs, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        else:
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}")
         # Output projection: input dim sharded -> XLA reduces over tp axis.
         return _dense(cfg.hidden_size, ("heads", "embed"), "output", self.dtype)(out)
 
@@ -107,6 +125,10 @@ class BertMLM(nn.Module):
         cfg = self.cfg
         deterministic = not train
         b, s = input_ids.shape
+        if s > cfg.max_position:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position "
+                f"{cfg.max_position}; build the model with seq_len={s}")
         if attention_mask is None:
             attention_mask = jnp.ones((b, s), jnp.bool_)
         else:
@@ -155,21 +177,31 @@ class BertMLM(nn.Module):
         return logits.astype(jnp.float32) + bias
 
 
+def _fit_positions(cfg: BertConfig, seq_len: Optional[int]) -> BertConfig:
+    """Grow the position table when the run's sequence outsizes it; the
+    canonical table (and so the canonical param count) is kept otherwise."""
+    if seq_len and seq_len > cfg.max_position:
+        cfg = dataclasses.replace(cfg, max_position=seq_len)
+    return cfg
+
+
 def bert_base_mlm(vocab_size: int = 30522, dtype: Dtype = jnp.bfloat16,
-                  **overrides: Any) -> BertMLM:
+                  seq_len: Optional[int] = None, **overrides: Any) -> BertMLM:
     cfg = BertConfig(vocab_size=vocab_size, **overrides)
-    return BertMLM(cfg, dtype=dtype)
+    return BertMLM(_fit_positions(cfg, seq_len), dtype=dtype)
 
 
 def bert_large_mlm(vocab_size: int = 30522, dtype: Dtype = jnp.bfloat16,
-                   **overrides: Any) -> BertMLM:
+                   seq_len: Optional[int] = None, **overrides: Any) -> BertMLM:
     cfg = BertConfig(vocab_size=vocab_size, hidden_size=1024, num_layers=24,
                      num_heads=16, intermediate_size=4096, **overrides)
-    return BertMLM(cfg, dtype=dtype)
+    return BertMLM(_fit_positions(cfg, seq_len), dtype=dtype)
 
 
-def tiny_bert_mlm(vocab_size: int = 1024, dtype: Dtype = jnp.float32) -> BertMLM:
+def tiny_bert_mlm(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
+                  seq_len: Optional[int] = None, **overrides: Any) -> BertMLM:
     """Test-sized BERT (used by unit tests and dryrun_multichip)."""
     cfg = BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
-                     num_heads=4, intermediate_size=128, max_position=128)
-    return BertMLM(cfg, dtype=dtype)
+                     num_heads=4, intermediate_size=128,
+                     **{"max_position": 128, **overrides})
+    return BertMLM(_fit_positions(cfg, seq_len), dtype=dtype)

@@ -1,0 +1,117 @@
+"""Ring attention — blockwise sequence/context parallelism over the ``seq``
+mesh axis.
+
+Long-context scaling the TPU-native way: each device holds one sequence shard
+of Q, K, V; K/V blocks rotate around the ``seq`` axis ring with
+``lax.ppermute`` (one ICI-neighbour hop per step) while each device
+accumulates its queries' attention with an online-softmax running state
+(max ``m``, normalizer ``l``, weighted-value ``acc`` — the flash-attention
+recurrence). After ``seq`` steps every query has seen every key, yet no
+device ever materializes the full (S, S) score matrix or the full K/V — HBM
+stays O(S_local) and the permutes overlap with block compute under XLA's
+scheduler.
+
+The reference had no long-context machinery at all (SURVEY.md §5.7 — a
+CNN-era DP tutorial); this subsystem is the capability the port adds to make
+sequence models first-class on TPU. Used inside the GSPMD train step via a
+nested ``shard_map`` (models/bert.py) so K/V rotation rides ICI explicitly
+while XLA still lays out everything else.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+# Large-negative instead of -inf: keeps exp() exactly 0 without inf-inf NaN
+# hazards in the running-max recurrence.
+_NEG = -1e30
+
+
+def _block_update(q, k, v, kv_mask, m, l, acc, scale):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); kv_mask: (B, Sk) True=attend.
+    Running state m, l: (B, H, Sq); acc: (B, H, Sq, D), all float32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(kv_mask[:, None, None, :], s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Re-mask after exp: a fully-masked block would otherwise contribute
+    # exp(_NEG - _NEG) = 1 per key.
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
+    """Exact (non-causal) attention over a ring of sequence shards.
+
+    Call under ``shard_map`` with the sequence dim sharded on ``axis_name``.
+    Shapes (per shard): q/k/v (B, S_local, H, D); kv_mask (B, S_local) bool.
+    Returns (B, S_local, H, D) in q.dtype. Collapses to one local block (no
+    permutes) when the axis has size 1, so the same code path serves
+    single-chip runs.
+    """
+    b, sq, h, d = q.shape
+    scale = d ** -0.5
+    n = lax.axis_size(axis_name)
+    m = jnp.full((b, h, sq), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    kv_mask = kv_mask.astype(jnp.bool_)
+
+    # Local block first, outside the loop: it both seeds the carry with the
+    # right varying-axes type (the NEG/zero inits are unvarying constants,
+    # which shard_map's loop typing rejects as a carry) and leaves exactly
+    # n-1 permutes in the ring.
+    m, l, acc = _block_update(q, k, v, kv_mask, m, l, acc, scale)
+    if n > 1:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def body(_, carry):
+            m, l, acc, k, v, msk = carry
+            # Rotate K/V (and their padding mask) one ICI neighbour along
+            # the ring, then fold the arriving block into the running state.
+            k, v, msk = lax.ppermute((k, v, msk), axis_name, perm)
+            m, l, acc = _block_update(q, k, v, msk, m, l, acc, scale)
+            return m, l, acc, k, v, msk
+
+        m, l, acc, *_ = lax.fori_loop(
+            1, n, body, (m, l, acc, k, v, kv_mask))
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]          # (B, H, Sq, D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)       # (B, Sq, H, D)
+
+
+def ring_attention_sharded(q, k, v, kv_mask, *,
+                           mesh: Optional[jax.sharding.Mesh] = None,
+                           seq_axis: str = "seq",
+                           batch_axes=("data", "fsdp"),
+                           head_axis: str = "model"):
+    """GSPMD-embeddable wrapper: shard_map over (batch, seq, heads).
+
+    Takes *global* (B, S, H, D) arrays inside a jit-traced program (ambient
+    mesh from ``use_mesh``), pins the ring layout — batch over the DP axes,
+    sequence over ``seq``, heads over ``model`` — and runs ``ring_attention``
+    per shard. Heads stay independent, so head sharding composes freely with
+    the sequence ring.
+    """
+    qkv_spec = P(batch_axes, seq_axis, head_axis, None)
+    mask_spec = P(batch_axes, seq_axis)
+    fn = functools.partial(ring_attention, axis_name=seq_axis)
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec)
+    return mapped(q, k, v, kv_mask)

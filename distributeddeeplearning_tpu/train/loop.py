@@ -50,7 +50,11 @@ def build(config: TrainConfig, total_steps: int):
     mesh = meshlib.make_mesh(config.parallel)
     dtype = _dtype(config)
     if spec.input_kind == "tokens":
-        model = spec.build(vocab_size=config.data.vocab_size, dtype=dtype)
+        kw: dict = dict(vocab_size=config.data.vocab_size, dtype=dtype,
+                        seq_len=config.data.seq_len)
+        if config.attention_impl:
+            kw["attention_impl"] = config.attention_impl
+        model = spec.build(**kw)
     else:
         model = spec.build(num_classes=config.data.num_classes, dtype=dtype)
 

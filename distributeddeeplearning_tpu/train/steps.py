@@ -190,7 +190,8 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
             params=params, opt_state=opt_state,
             batch_stats=variables.get("batch_stats"))
 
-    abstract = jax.eval_shape(init_fn, rng)
+    with use_mesh(mesh):  # model may embed mesh-dependent shard_maps (ring)
+        abstract = jax.eval_shape(init_fn, rng)
     with _unreplicated_rules_ctx(config):
         specs = nn.logical_to_mesh(nn.get_partition_spec(abstract))
     shardings = jax.tree_util.tree_map(

@@ -41,6 +41,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fsdp", type=int, default=None)
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
+    p.add_argument("--attn", default=None, choices=["dense", "ring"],
+                   help="attention impl for transformer models")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="sequence length for token models")
     p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw"])
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
@@ -122,9 +126,14 @@ def build_config(args: argparse.Namespace):
     if updates:
         cfg = cfg.replace(parallel=dataclasses.replace(par, **updates))
 
+    if args.attn:
+        cfg = cfg.replace(attention_impl=args.attn)
+
     data_updates = {}
     if args.synthetic is not None:
         data_updates["synthetic"] = True
+    if args.seq_len:
+        data_updates["seq_len"] = args.seq_len
     if args.data_dir:
         data_updates["data_dir"] = args.data_dir
         data_updates["synthetic"] = False

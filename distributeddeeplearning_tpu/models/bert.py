@@ -67,12 +67,15 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
+        # BertMLM always materializes a bool attention_mask before calling in.
         if cfg.attention_impl == "ring":
             from distributeddeeplearning_tpu.parallel import ring_attention
-            kv_mask = (jnp.ones((b, s), jnp.bool_) if mask is None
-                       else mask.astype(jnp.bool_))
             out = ring_attention.ring_attention_sharded(
-                q, k, v, kv_mask).reshape(b, s, -1)
+                q, k, v, mask).reshape(b, s, -1)
+        elif cfg.attention_impl == "flash":
+            from distributeddeeplearning_tpu.ops.flash_attention import (
+                flash_attention_sharded)
+            out = flash_attention_sharded(q, k, v, mask).reshape(b, s, -1)
         elif cfg.attention_impl == "dense":
             scale = head_dim ** -0.5
             # (B, heads, S, S) scores — contiguous MXU matmuls via einsum.

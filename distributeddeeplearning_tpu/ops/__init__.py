@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+XLA's fusion covers most of the framework (SURVEY.md §1b); these kernels take
+over where fusion can't: flash attention keeps the (S, S) score matrix out of
+HBM entirely, computing softmax online in VMEM blocks on the MXU.
+"""
+
+from distributeddeeplearning_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_sharded,
+)

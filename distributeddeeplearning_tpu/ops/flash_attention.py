@@ -1,0 +1,292 @@
+"""Flash attention as a Pallas TPU kernel (forward + custom-VJP backward).
+
+Why a kernel at all: dense attention materializes the (S, S) probability
+matrix in HBM — at BERT-base shapes that is B*H*S*S*4 bytes of write+read
+traffic per layer, and HBM bandwidth is the TPU's usual bottleneck. This
+kernel streams K/V through VMEM in (BLOCK_K, D) tiles against (BLOCK_Q, D)
+query tiles, runs the scores on the MXU, and keeps the online-softmax running
+state (m, l, acc) in f32 VMEM scratch — O(S·D) HBM traffic, no score matrix.
+
+Non-causal with a key-padding mask — exactly the attention BERT needs
+(models/bert.py). The backward pass recomputes block scores from the saved
+logsumexp (the flash recurrence) in two kernels: dq (grid over Q tiles) and
+dk/dv (grid over K tiles).
+
+Kernels run compiled on TPU and in Pallas interpret mode elsewhere, so the
+CPU test mesh exercises the same code path (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(size: int, target: int) -> int:
+    """Largest divisor of ``size`` not exceeding ``target`` — keeps grids
+    exact without padding logic (sequence lengths here are powers of two)."""
+    b = min(size, target)
+    while size % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                scale: float, block_k: int):
+    q = q_ref[0].astype(jnp.float32)                     # (BQ, D)
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    nk = sk // block_k
+
+    m = jnp.full((bq, 1), _NEG, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, pl.ds(j * block_k, block_k)] != 0   # (BK,)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (BQ, BK)
+        s = jnp.where(msk[None, :], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(msk[None, :], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m, l, acc))
+    # Fully-masked rows: zero output, lse pinned to 0 so backward's
+    # exp(_NEG - 0) underflows to 0 rather than NaN.
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), 0.0)
+
+
+def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    bq = _block(s, block_q)
+    grid = (bh, s // bq)
+    kernel = functools.partial(_fwd_kernel, scale=scale,
+                               block_k=_block(s, block_k))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward: dq over Q tiles; dk/dv over K tiles. Scores recomputed from lse.
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, scale: float, block_k: int):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                             # (BQ, 1)
+    delta = delta_ref[0][:, None]                         # (BQ, 1)
+    bq, d = q.shape
+    nk = k_ref.shape[1] // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, pl.ds(j * block_k, block_k)] != 0
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(msk[None, :], s, _NEG)
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale: float, block_q: int):
+    k = k_ref[0].astype(jnp.float32)                      # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    msk = mask_ref[0] != 0                                # (BK,)
+    bk, d = k.shape
+    nq = q_ref.shape[1] // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(msk[None, :], s, _NEG)
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (BQ, BK)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zero = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, mask, out, lse = residuals
+    bh, s, d = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    bq = _block(s, block_q)
+    bk = _block(s, block_k)
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0))
+    qfull = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    vec_q = pl.BlockSpec((1, bq), lambda b, i: (b, i))
+    vec_full = pl.BlockSpec((1, s), lambda b, i: (b, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=bk),
+        grid=(bh, s // bq),
+        in_specs=[qspec, qfull, qfull, vec_full, qspec, vec_q, vec_q],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        interpret=interpret,
+    )(q, k, v, mask, g, lse, delta)[0]
+
+    kspec = pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))
+    vec_k = pl.BlockSpec((1, bk), lambda b, j: (b, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=bq),
+        grid=(bh, s // bk),
+        in_specs=[qfull, kspec, kspec, vec_k, qfull, vec_full, vec_full],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        interpret=interpret,
+    )(q, k, v, mask, g, lse, delta)
+    return dq, dk, dv, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused non-causal attention with a key-padding mask.
+
+    q/k/v: (B, S, H, D) — the models' layout; kv_mask: (B, S) (True/nonzero
+    = attend), or None for all-valid. Returns (B, S, H, D) in q.dtype.
+    Differentiable w.r.t. q/k/v via the flash backward kernels.
+    """
+    b, s, h, d = q.shape
+    if interpret is None:
+        interpret = _should_interpret()
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, s), jnp.int32)
+    kv_mask = jnp.broadcast_to(
+        kv_mask.astype(jnp.int32)[:, None, :], (b, h, s)).reshape(b * h, s)
+
+    def to_bh(x):  # (B, S, H, D) -> (B*H, S, D)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), kv_mask,
+                 d ** -0.5, block_q, block_k, interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(q, k, v, kv_mask=None, *,
+                            batch_axes=("data", "fsdp"),
+                            head_axis: str = "model", **kw):
+    """GSPMD-embeddable flash attention: Pallas calls don't partition under
+    jit's sharding propagation, so inside a sharded program the kernel must
+    run per-shard via shard_map — batch over the DP axes, heads over
+    ``model``, sequence local (for a sharded sequence use ring attention).
+
+    Falls through to the plain kernel when no mesh context is active
+    (single-device apply/tests).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return flash_attention(q, k, v, kv_mask, **kw)
+    if mesh.shape.get("seq", 1) > 1:
+        raise ValueError(
+            "flash attention keeps the full sequence on every device and "
+            "would silently all-gather a seq-sharded activation; with "
+            "seq-axis parallelism use attention_impl='ring' instead")
+    qkv_spec = P(batch_axes, None, head_axis, None)
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], jnp.int32)
+    fn = functools.partial(flash_attention, **kw)
+    # check_vma=False: pallas_call's out_shape carries no varying-axes info;
+    # the body is pure per-shard compute (no collectives), so the check adds
+    # nothing here.
+    return jax.shard_map(
+        fn, in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axes, None)),
+        out_specs=qkv_spec, check_vma=False)(q, k, v, kv_mask)

@@ -107,6 +107,12 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
     per shard. Heads stay independent, so head sharding composes freely with
     the sequence ring.
     """
+    if mesh is None:
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is None or ambient.empty:
+            # No mesh context (single-device apply / notebook use): one local
+            # block is the whole ring.
+            return _local_attention(q, k, v, kv_mask)
     qkv_spec = P(batch_axes, seq_axis, head_axis, None)
     mask_spec = P(batch_axes, seq_axis)
     fn = functools.partial(ring_attention, axis_name=seq_axis)
@@ -115,3 +121,16 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec)
     return mapped(q, k, v, kv_mask)
+
+
+def _local_attention(q, k, v, kv_mask):
+    """The ring's single-block case without a mesh: one _block_update pass
+    (still exact, still O(S) memory in scores per block — here S is global)."""
+    b, sq, h, d = q.shape
+    m = jnp.full((b, h, sq), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    m, l, acc = _block_update(q, k, v, kv_mask.astype(jnp.bool_), m, l, acc,
+                              d ** -0.5)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -47,6 +47,11 @@ def build(config: TrainConfig, total_steps: int):
     checkpoint restore."""
     spec = model_spec(config.model)
     _ = config.per_device_batch  # early, friendly divisibility error
+    if config.attention_impl == "flash" and config.parallel.seq > 1:
+        raise ValueError(
+            "attention_impl='flash' is incompatible with seq-axis "
+            "parallelism (it needs the full sequence per device); use "
+            "attention_impl='ring' for seq>1")
     mesh = meshlib.make_mesh(config.parallel)
     dtype = _dtype(config)
     if spec.input_kind == "tokens":

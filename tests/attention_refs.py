@@ -1,0 +1,24 @@
+"""Shared oracle + fixtures for the attention test suites (ring, flash):
+one dense softmax(QK^T)V reference so both kernels validate against the
+identical ground truth."""
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_reference(q, k, v, kv_mask=None):
+    """softmax(QK^T/sqrt(d))V with optional key-padding mask; (B,S,H,D) io."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def random_qkv(key, b=2, s=32, h=4, d=8, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (jax.random.normal(kq, shape, dtype),
+            jax.random.normal(kk, shape, dtype),
+            jax.random.normal(kv, shape, dtype))

@@ -16,22 +16,7 @@ from distributeddeeplearning_tpu.config import (
     DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import ring_attention as ring
-
-
-def dense_reference(q, k, v, kv_mask):
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    s = jnp.where(kv_mask[:, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-
-
-def random_qkv(key, b=2, s=32, h=4, d=8):
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
-    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
-    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
-    return q, k, v
+from tests.attention_refs import dense_reference, random_qkv
 
 
 @pytest.mark.parametrize("seq_shards", [1, 2, 4, 8])

@@ -41,7 +41,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fsdp", type=int, default=None)
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
-    p.add_argument("--attn", default=None, choices=["dense", "ring"],
+    p.add_argument("--attn", default=None,
+                   choices=["dense", "ring", "flash"],
                    help="attention impl for transformer models")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")

@@ -1,0 +1,395 @@
+// Native data-loader runtime — the in-tree DALI equivalent (SURVEY.md §2 #6).
+//
+// The reference fed its trainers from DALI / tf.data *native* worker threads;
+// this library is our same-role component for image-folder ImageNet layouts:
+// a C++ thread pool that reads JPEG files, decodes them with libjpeg(-turbo),
+// applies the standard ResNet50 recipe (random-resized-crop 8-100% area +
+// horizontal flip for train; resize-256/center-crop-224 for eval; per-channel
+// normalize), and assembles float32 NHWC batches into a bounded ring of batch
+// slots so the host stays ahead of the accelerator.
+//
+// Determinism contract (matches data/imagenet.py's resume story): the sample
+// order is a pure function of (seed, epoch) — per-epoch Fisher-Yates over the
+// process's shard — so batch k is reproducible and checkpoint-resume can
+// restart the stream at any batch index.
+//
+// Exposed as a C ABI for ctypes (data/native.py). No Python.h dependency.
+
+#include <atomic>
+#include <algorithm>
+#include <memory>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// JPEG decode (libjpeg, error-safe via setjmp)
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decoded RGB image, 8-bit HWC.
+struct Image {
+  int h = 0, w = 0;
+  std::vector<uint8_t> rgb;
+  bool ok() const { return h > 0 && w > 0; }
+};
+
+// Decode with DCT scaling: libjpeg can decode at 1/2, 1/4, 1/8 resolution
+// almost for free; pick the largest reduction that keeps both sides >=
+// 2*target (preserves crop/resize quality while cutting IDCT work — the
+// cheap half of DALI's fused decode-and-crop trick).
+Image decode_jpeg(const uint8_t* buf, size_t len, int target) {
+  Image img;
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_error_exit;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return Image{};
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.dct_method = JDCT_IFAST;
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = 1;
+  if (target > 0) {
+    while (cinfo.scale_denom < 8 &&
+           (int)cinfo.image_width / (int)(cinfo.scale_denom * 2) >= 2 * target &&
+           (int)cinfo.image_height / (int)(cinfo.scale_denom * 2) >= 2 * target) {
+      cinfo.scale_denom *= 2;
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {  // JCS_RGB should guarantee 3
+    jpeg_destroy_decompress(&cinfo);
+    return Image{};
+  }
+  img.w = cinfo.output_width;
+  img.h = cinfo.output_height;
+  img.rgb.resize((size_t)img.h * img.w * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = img.rgb.data() + (size_t)cinfo.output_scanline * img.w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return img;
+}
+
+// ---------------------------------------------------------------------------
+// Crop + bilinear resize + normalize
+// ---------------------------------------------------------------------------
+
+struct Crop {
+  int y, x, h, w;
+};
+
+// tf.image.sample_distorted_bounding_box-style random area crop.
+Crop random_resized_crop(std::mt19937_64& rng, int h, int w) {
+  std::uniform_real_distribution<float> area_d(0.08f, 1.0f);
+  std::uniform_real_distribution<float> logr_d(std::log(3.0f / 4.0f),
+                                               std::log(4.0f / 3.0f));
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    float area = area_d(rng) * (float)h * (float)w;
+    float aspect = std::exp(logr_d(rng));
+    int cw = (int)std::lround(std::sqrt(area * aspect));
+    int ch = (int)std::lround(std::sqrt(area / aspect));
+    if (cw > 0 && ch > 0 && cw <= w && ch <= h) {
+      std::uniform_int_distribution<int> yd(0, h - ch), xd(0, w - cw);
+      return Crop{yd(rng), xd(rng), ch, cw};
+    }
+  }
+  // Fallback: central crop of the shorter side (tf's use_image_if_no_bbox).
+  int side = std::min(h, w);
+  return Crop{(h - side) / 2, (w - side) / 2, side, side};
+}
+
+// Eval: crop fraction target/(target+32) of the shorter side, centered —
+// identical protocol to data/imagenet.py::_decode_and_center_crop.
+Crop center_crop(int h, int w, int target) {
+  int shorter = std::min(h, w);
+  int crop = (int)((float)target / (float)(target + 32) * (float)shorter);
+  crop = std::min(crop, shorter);
+  return Crop{(h - crop) / 2, (w - crop) / 2, crop, crop};
+}
+
+// Bilinear resize of an RGB crop region into out[target*target*3] float32,
+// half-pixel centers (matches tf.image.resize v2 / torchvision).
+void resize_bilinear(const Image& img, const Crop& c, int target, float* out,
+                     bool hflip) {
+  const float sy = (float)c.h / (float)target;
+  const float sx = (float)c.w / (float)target;
+  for (int oy = 0; oy < target; ++oy) {
+    float fy = ((float)oy + 0.5f) * sy - 0.5f;
+    int y0 = (int)std::floor(fy);
+    float wy = fy - (float)y0;
+    int y0c = std::clamp(y0, 0, c.h - 1) + c.y;
+    int y1c = std::clamp(y0 + 1, 0, c.h - 1) + c.y;
+    for (int ox = 0; ox < target; ++ox) {
+      float fx = ((float)ox + 0.5f) * sx - 0.5f;
+      int x0 = (int)std::floor(fx);
+      float wx = fx - (float)x0;
+      int x0c = std::clamp(x0, 0, c.w - 1) + c.x;
+      int x1c = std::clamp(x0 + 1, 0, c.w - 1) + c.x;
+      const uint8_t* p00 = &img.rgb[((size_t)y0c * img.w + x0c) * 3];
+      const uint8_t* p01 = &img.rgb[((size_t)y0c * img.w + x1c) * 3];
+      const uint8_t* p10 = &img.rgb[((size_t)y1c * img.w + x0c) * 3];
+      const uint8_t* p11 = &img.rgb[((size_t)y1c * img.w + x1c) * 3];
+      int out_x = hflip ? (target - 1 - ox) : ox;
+      float* dst = out + ((size_t)oy * target + out_x) * 3;
+      for (int ch = 0; ch < 3; ++ch) {
+        float top = (1.0f - wx) * p00[ch] + wx * p01[ch];
+        float bot = (1.0f - wx) * p10[ch] + wx * p11[ch];
+        dst[ch] = (1.0f - wy) * top + wy * bot;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Loader: deterministic shuffled stream -> thread pool -> batch-slot ring
+// ---------------------------------------------------------------------------
+
+struct Sample {
+  std::string path;
+  int32_t label;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct DdlLoader {
+  std::vector<Sample> samples;
+  int32_t batch = 0, image_size = 0;
+  bool train = false, repeat = false;
+  uint64_t seed = 0;
+  float mean[3], stdev[3];
+
+  // Batch-slot ring.
+  struct Slot {
+    std::vector<float> images;
+    std::vector<int32_t> labels;
+    std::atomic<int32_t> done{0};   // samples completed
+    int64_t batch_idx = -1;
+    bool ready = false;
+  };
+  std::vector<std::unique_ptr<Slot>> slots;  // Slot holds atomics (immovable)
+  int64_t next_batch_to_emit = 0;      // consumer cursor (batches)
+  std::atomic<int64_t> next_sample{0};  // global sample cursor (monotonic)
+  int64_t total_batches = -1;           // -1 = infinite (repeat)
+
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  bool stop = false;
+
+  // Per-epoch shuffled order cache (epoch -> permutation of sample indices).
+  std::mutex order_mu;
+  int64_t order_epoch = -1;
+  std::vector<int64_t> order;
+
+  int64_t n() const { return (int64_t)samples.size(); }
+  int64_t batches_per_epoch() const { return n() / batch; }
+
+  // Sample index for global sequence position `pos` (deterministic).
+  int64_t index_at(int64_t pos) {
+    int64_t per_epoch = batches_per_epoch() * batch;  // drop remainder
+    int64_t epoch = pos / per_epoch, off = pos % per_epoch;
+    std::lock_guard<std::mutex> lk(order_mu);
+    if (epoch != order_epoch) {
+      order.resize(n());
+      std::iota(order.begin(), order.end(), 0);
+      if (train) {
+        std::mt19937_64 rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)epoch);
+        std::shuffle(order.begin(), order.end(), rng);
+      }
+      order_epoch = epoch;
+    }
+    return order[off];
+  }
+
+  void fill_sample(int64_t pos, Slot& slot, int32_t slot_off) {
+    const Sample& s = samples[index_at(pos)];
+    float* out = slot.images.data() + (size_t)slot_off * image_size * image_size * 3;
+    slot.labels[slot_off] = s.label;
+
+    Image img;
+    {
+      FILE* f = std::fopen(s.path.c_str(), "rb");
+      if (f) {
+        std::fseek(f, 0, SEEK_END);
+        long len = std::ftell(f);
+        std::fseek(f, 0, SEEK_SET);
+        std::vector<uint8_t> buf((size_t)std::max(len, 0L));
+        if (len > 0 && std::fread(buf.data(), 1, (size_t)len, f) == (size_t)len) {
+          img = decode_jpeg(buf.data(), buf.size(), image_size);
+        }
+        std::fclose(f);
+      }
+    }
+    if (!img.ok()) {
+      // Unreadable/corrupt file: deterministic gray frame (keeps the stream
+      // aligned instead of shifting every later sample).
+      for (size_t i = 0; i < (size_t)image_size * image_size; ++i)
+        for (int ch = 0; ch < 3; ++ch)
+          out[i * 3 + ch] = (128.0f - mean[ch]) / stdev[ch];
+      return;
+    }
+
+    Crop crop;
+    bool hflip = false;
+    if (train) {
+      // Augmentation RNG keyed by (seed, pos): reproducible per sample.
+      std::mt19937_64 rng(seed ^ (0xda3e39cb94b95bdbULL * (uint64_t)(pos + 1)));
+      crop = random_resized_crop(rng, img.h, img.w);
+      hflip = (rng() & 1) != 0;
+    } else {
+      crop = center_crop(img.h, img.w, image_size);
+    }
+    resize_bilinear(img, crop, image_size, out, hflip);
+    for (size_t i = 0; i < (size_t)image_size * image_size; ++i)
+      for (int ch = 0; ch < 3; ++ch) {
+        float& v = out[i * 3 + ch];
+        v = (v - mean[ch]) / stdev[ch];
+      }
+  }
+
+  void worker() {
+    for (;;) {
+      int64_t pos = next_sample.fetch_add(1);
+      int64_t b = pos / batch;
+      if (total_batches >= 0 && b >= total_batches) return;
+      Slot* slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // Wait until batch b's slot is free (ring depth bound) or shutdown.
+        cv_space.wait(lk, [&] {
+          return stop || b < next_batch_to_emit + (int64_t)slots.size();
+        });
+        if (stop) return;
+        slot = slots[b % slots.size()].get();
+        if (slot->batch_idx != b) {
+          slot->batch_idx = b;
+          slot->done.store(0);
+          slot->ready = false;
+        }
+      }
+      fill_sample(pos, *slot, (int32_t)(pos % batch));
+      if (slot->done.fetch_add(1) + 1 == batch) {
+        std::lock_guard<std::mutex> lk(mu);
+        slot->ready = true;
+        cv_ready.notify_all();
+      }
+    }
+  }
+
+  // Returns batch index, or -1 when the (finite) stream is exhausted.
+  int64_t next(float* images_out, int32_t* labels_out) {
+    int64_t b = next_batch_to_emit;
+    if (total_batches >= 0 && b >= total_batches) return -1;
+    Slot& slot = *slots[b % slots.size()];
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_ready.wait(lk, [&] {
+        return stop || (slot.ready && slot.batch_idx == b);
+      });
+      if (stop) return -1;
+    }
+    std::memcpy(images_out, slot.images.data(),
+                slot.images.size() * sizeof(float));
+    std::memcpy(labels_out, slot.labels.data(),
+                slot.labels.size() * sizeof(int32_t));
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      slot.ready = false;
+      slot.batch_idx = -1;
+      ++next_batch_to_emit;
+      cv_space.notify_all();
+    }
+    return b;
+  }
+};
+
+DdlLoader* ddl_loader_create(
+    const char** paths, const int32_t* labels, int64_t num_samples,
+    int32_t batch, int32_t image_size, int32_t train, uint64_t seed,
+    int32_t num_threads, int32_t queue_depth, int64_t start_batch,
+    int32_t repeat, const float* mean3, const float* stdev3) {
+  if (num_samples <= 0 || batch <= 0 || image_size <= 0 ||
+      num_samples < batch)
+    return nullptr;
+  auto* L = new DdlLoader();
+  L->samples.reserve((size_t)num_samples);
+  for (int64_t i = 0; i < num_samples; ++i)
+    L->samples.push_back(Sample{paths[i], labels[i]});
+  L->batch = batch;
+  L->image_size = image_size;
+  L->train = train != 0;
+  L->repeat = repeat != 0;
+  L->seed = seed;
+  for (int c = 0; c < 3; ++c) {
+    L->mean[c] = mean3 ? mean3[c] : 0.0f;
+    L->stdev[c] = stdev3 ? stdev3[c] : 1.0f;
+  }
+  L->total_batches = L->repeat ? -1 : L->batches_per_epoch();
+  L->next_batch_to_emit = start_batch;
+  L->next_sample.store(start_batch * batch);
+
+  int depth = std::max(queue_depth, 2);
+  for (int i = 0; i < depth; ++i) {
+    auto s = std::make_unique<DdlLoader::Slot>();
+    s->images.resize((size_t)batch * image_size * image_size * 3);
+    s->labels.resize((size_t)batch);
+    L->slots.push_back(std::move(s));
+  }
+  int threads = std::max(num_threads, 1);
+  for (int t = 0; t < threads; ++t)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+int64_t ddl_loader_next(DdlLoader* L, float* images, int32_t* labels) {
+  return L ? L->next(images, labels) : -1;
+}
+
+void ddl_loader_destroy(DdlLoader* L) {
+  if (!L) return;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop = true;
+    L->cv_space.notify_all();
+    L->cv_ready.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+int32_t ddl_loader_abi_version() { return 1; }
+
+}  // extern "C"

@@ -52,6 +52,7 @@ class DataConfig:
     dataset: str = "imagenet"
     data_dir: Optional[str] = None
     synthetic: bool = True        # config 1: "synthetic data" BASELINE.json:7
+    loader: str = "auto"          # auto | tf | native (csrc/ C++ loader)
     image_size: int = 224
     num_classes: int = 1000
     shuffle_buffer: int = 16384
@@ -103,7 +104,7 @@ class TrainConfig:
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
     fail_at_step: Optional[int] = None  # fault injection (SURVEY.md §5.3)
-    attention_impl: Optional[str] = None  # None=model default; dense | ring
+    attention_impl: Optional[str] = None  # None=default; dense|ring|flash
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

@@ -37,6 +37,16 @@ def make_source(config: TrainConfig, input_kind: str,
         from distributeddeeplearning_tpu.data import tokens
         return tokens.make_token_source(
             config, sharding, start_step=start_step, train=train)
-    from distributeddeeplearning_tpu.data import imagenet
+    from distributeddeeplearning_tpu.data import imagenet, native
+    loader = d.loader
+    if loader == "auto":
+        # The C++ loader owns image-folder layouts when it can build;
+        # TFRecords stay on tf.data (its native record readers).
+        loader = ("native"
+                  if (imagenet.detect_layout(d.data_dir) == "folder"
+                      and native.available()) else "tf")
+    if loader == "native":
+        return native.make_native_source(
+            config, sharding, train=train, start_step=start_step)
     return imagenet.make_imagenet_source(
         config, sharding, train=train, start_step=start_step)

@@ -1,0 +1,206 @@
+"""ctypes bindings + source adapter for the native C++ loader (csrc/).
+
+The in-tree DALI-equivalent (SURVEY.md §2 #6): JPEG decode, ResNet-recipe
+augmentation, and batch assembly run in a C++ thread pool behind a bounded
+ring of batch slots; Python only memcpys finished float32 NHWC batches and
+ships them to HBM. Preferred for image-folder ImageNet layouts; tf.data
+(data/imagenet.py) remains the TFRecord path and the fallback when no C++
+toolchain is available.
+
+The library is compiled on first use (g++ -shared against libjpeg, ~2 s) and
+cached next to the package; set ``DDL_NATIVE_LOADER=0`` to force the tf.data
+fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_ERR: Optional[str] = None
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, "_native", "libddl_loader.so")
+_SRC_PATH = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)),
+                         "csrc", "ddl_loader.cc")
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    if (os.path.exists(_SO_PATH)
+            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH)):
+        return _SO_PATH
+    # Compile to a per-pid temp path and rename into place: an interrupted
+    # build can't leave a half-written .so with a fresh mtime, and multiple
+    # processes racing on first use each install a complete library.
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-o", tmp, _SRC_PATH, "-ljpeg", "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native loader build failed:\n{proc.stderr}")
+        os.replace(tmp, _SO_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _SO_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _LIB, _LIB_ERR
+    with _BUILD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LIB_ERR is not None:
+            raise RuntimeError(_LIB_ERR)
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+            _LIB_ERR = f"native loader unavailable: {e}"
+            raise RuntimeError(_LIB_ERR) from e
+        lib.ddl_loader_create.restype = ctypes.c_void_p
+        lib.ddl_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),                 # paths
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,  # labels, n
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # batch,size,train
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,  # seed,thr,depth
+            ctypes.c_int64, ctypes.c_int32,                   # start,repeat
+            ctypes.POINTER(ctypes.c_float),                   # mean
+            ctypes.POINTER(ctypes.c_float),                   # stdev
+        ]
+        lib.ddl_loader_next.restype = ctypes.c_int64
+        lib.ddl_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.ddl_loader_destroy.restype = None
+        lib.ddl_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.ddl_loader_abi_version.restype = ctypes.c_int32
+        lib.ddl_loader_abi_version.argtypes = []
+        assert lib.ddl_loader_abi_version() == 1
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    """True when the native loader can be (or has been) built and loaded."""
+    if os.environ.get("DDL_NATIVE_LOADER", "1") == "0":
+        return False
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class NativeImageLoader:
+    """Iterator over (image, label) host batches from the C++ loader.
+
+    images: float32 NHWC, already normalized; labels: int32. The stream is
+    deterministic in (seed, batch index), sharded per process, and resumable
+    via ``start_batch``.
+    """
+
+    def __init__(self, paths: list[str], labels: list[int], *,
+                 batch_size: int, image_size: int, train: bool, seed: int,
+                 num_threads: Optional[int] = None, queue_depth: int = 3,
+                 start_batch: int = 0, repeat: Optional[bool] = None,
+                 mean=None, stdev=None):
+        from distributeddeeplearning_tpu.data import imagenet
+
+        lib = _load()
+        n = len(paths)
+        assert n == len(labels) and n >= batch_size
+        self._lib = lib
+        self._batch = batch_size
+        self._size = image_size
+        self.batches_per_epoch = n // batch_size
+        c_paths = (ctypes.c_char_p * n)(
+            *[p.encode() for p in paths])
+        c_labels = (ctypes.c_int32 * n)(*labels)
+        mean = np.asarray(mean if mean is not None else imagenet.MEAN_RGB,
+                          np.float32)
+        stdev = np.asarray(stdev if stdev is not None else
+                           imagenet.STDDEV_RGB, np.float32)
+        c_mean = (ctypes.c_float * 3)(*mean)
+        c_std = (ctypes.c_float * 3)(*stdev)
+        if repeat is None:
+            repeat = train
+        if num_threads is None:
+            num_threads = min(max((os.cpu_count() or 4) - 1, 2), 16)
+        self._handle = lib.ddl_loader_create(
+            c_paths, c_labels, n, batch_size, image_size, int(train),
+            seed, num_threads, queue_depth, start_batch, int(repeat),
+            c_mean, c_std)
+        if not self._handle:
+            raise RuntimeError("ddl_loader_create failed (bad arguments?)")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        images = np.empty((self._batch, self._size, self._size, 3),
+                          np.float32)
+        labels = np.empty((self._batch,), np.int32)
+        idx = self._lib.ddl_loader_next(
+            self._handle,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if idx < 0:
+            raise StopIteration
+        return {"image": images, "label": labels}
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.ddl_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_native_source(config, sharding, *, train: bool = True,
+                       start_step: int = 0):
+    """StreamSource over the native loader for image-folder layouts.
+
+    Shards samples across processes the same way the tf.data path does
+    (every process_count-th sample), converts to the config's dtype on
+    device via the StreamSource put.
+    """
+    import jax
+
+    from distributeddeeplearning_tpu.data import imagenet
+
+    d = config.data
+    paths, labels = imagenet.folder_index(
+        d.data_dir, "train" if train else "val")
+    pidx, pcount = jax.process_index(), jax.process_count()
+    paths = paths[pidx::pcount]
+    labels = labels[pidx::pcount]
+    per_process = config.global_batch_size // pcount
+    loader = NativeImageLoader(
+        paths, labels, batch_size=per_process, image_size=d.image_size,
+        train=train, seed=config.seed, start_batch=start_step if train else 0)
+
+    it = iter(loader)
+    if config.dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        def cast(b):
+            return {"image": b["image"].astype(jnp.bfloat16),
+                    "label": b["label"]}
+        it = (cast(b) for b in it)
+    src = imagenet.StreamSource(it, sharding, first_step=start_step)
+    src._native_loader = loader  # keep alive; closed on GC
+    return src

@@ -2,15 +2,18 @@
 
 Why a kernel at all: dense attention materializes the (S, S) probability
 matrix in HBM — at BERT-base shapes that is B*H*S*S*4 bytes of write+read
-traffic per layer, and HBM bandwidth is the TPU's usual bottleneck. This
-kernel streams K/V through VMEM in (BLOCK_K, D) tiles against (BLOCK_Q, D)
-query tiles, runs the scores on the MXU, and keeps the online-softmax running
-state (m, l, acc) in f32 VMEM scratch — O(S·D) HBM traffic, no score matrix.
+traffic per layer, and HBM bandwidth is the TPU's usual bottleneck. These
+kernels iterate a (batch*heads, Q-tiles, K-tiles) grid where each step holds
+only (BLOCK, D) tiles of Q/K/V in VMEM — Pallas streams the tiles per grid
+step — with the online-softmax running state (m, l, acc) carried across the
+K dimension in f32 VMEM scratch. HBM traffic is O(S·D) per Q-tile row and
+VMEM residency is O(BLOCK·D), so sequence length is bounded by HBM, not VMEM.
 
 Non-causal with a key-padding mask — exactly the attention BERT needs
 (models/bert.py). The backward pass recomputes block scores from the saved
-logsumexp (the flash recurrence) in two kernels: dq (grid over Q tiles) and
-dk/dv (grid over K tiles).
+logsumexp (the flash recurrence) in two kernels: dq (accumulated over the
+K-tile grid axis) and dk/dv (accumulated over the Q-tile grid axis); the
+revisited output blocks stay resident in VMEM across the accumulation axis.
 
 Kernels run compiled on TPU and in Pallas interpret mode elsewhere, so the
 CPU test mesh exercises the same code path (SURVEY.md §4).
@@ -43,69 +46,74 @@ def _block(size: int, target: int) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Forward
+# Forward: grid (B*H, nQ, nK); m/l/acc scratch carries across the K axis.
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                scale: float, block_k: int):
-    q = q_ref[0].astype(jnp.float32)                     # (BQ, D)
-    bq, d = q.shape
-    sk = k_ref.shape[1]
-    nk = sk // block_k
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float):
+    j = pl.program_id(2)
 
-    m = jnp.full((bq, 1), _NEG, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        msk = mask_ref[0, pl.ds(j * block_k, block_k)] != 0   # (BK,)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # (BQ, BK)
-        s = jnp.where(msk[None, :], s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(msk[None, :], p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
+    q = q_ref[0].astype(jnp.float32)                      # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)                      # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    msk = mask_ref[0] != 0                                # (BK,)
 
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m, l, acc))
-    # Fully-masked rows: zero output, lse pinned to 0 so backward's
-    # exp(_NEG - 0) underflows to 0 rather than NaN.
-    safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), 0.0)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # (BQ, BK)
+    s = jnp.where(msk[None, :], s, _NEG)
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(msk[None, :], p, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        l = l_scr[:]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # Fully-masked rows: zero output, lse pinned to 0 so backward's
+        # exp(_NEG - 0) underflows to 0 rather than NaN.
+        lse_ref[0] = jnp.where(
+            l[:, 0] > 0, m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
 
 
 def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
     bh, s, d = q.shape
-    bq = _block(s, block_q)
-    grid = (bh, s // bq)
-    kernel = functools.partial(_fwd_kernel, scale=scale,
-                               block_k=_block(s, block_k))
+    bq, bk = _block(s, block_q), _block(s, block_k)
     out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
+        functools.partial(_fwd_kernel, scale=scale),
+        grid=(bh, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk), lambda b, i, j: (b, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, mask)
@@ -113,74 +121,81 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
 
 
 # ---------------------------------------------------------------------------
-# Backward: dq over Q tiles; dk/dv over K tiles. Scores recomputed from lse.
+# Backward: dq accumulates over the K grid axis; dk/dv over the Q grid axis.
+# Scores are recomputed from the saved lse (flash recurrence).
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale: float, block_k: int):
+               dq_ref, dq_scr, *, scale: float):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                             # (BQ, 1)
-    delta = delta_ref[0][:, None]                         # (BQ, 1)
-    bq, d = q.shape
-    nk = k_ref.shape[1] // block_k
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    msk = mask_ref[0] != 0
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        msk = mask_ref[0, pl.ds(j * block_k, block_k)] != 0
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = jnp.where(msk[None, :], s, _NEG)
-        p = jnp.exp(s - lse)                              # (BQ, BK)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = jnp.where(msk[None, :], s, _NEG)
+    p = jnp.exp(s - lse)                                  # (BQ, BK)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_scr[:] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale: float, block_q: int):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float):
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
     k = k_ref[0].astype(jnp.float32)                      # (BK, D)
     v = v_ref[0].astype(jnp.float32)
-    msk = mask_ref[0] != 0                                # (BK,)
-    bk, d = k.shape
-    nq = q_ref.shape[1] // block_q
+    msk = mask_ref[0] != 0
+    q = q_ref[0].astype(jnp.float32)                      # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = jnp.where(msk[None, :], s, _NEG)
-        p = jnp.exp(s - lse)                              # (BQ, BK)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                     # (BQ, BK)
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk, dv
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = jnp.where(msk[None, :], s, _NEG)
+    p = jnp.exp(s - lse)                                  # (BQ, BK)
+    dv_scr[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                         # (BQ, BK)
+    dk_scr[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    zero = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (zero, zero))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(scale, block_q, block_k, interpret, residuals, g):
@@ -188,31 +203,37 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
     bh, s, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
-    bq = _block(s, block_q)
-    bk = _block(s, block_k)
-    qspec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0))
-    qfull = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
-    vec_q = pl.BlockSpec((1, bq), lambda b, i: (b, i))
-    vec_full = pl.BlockSpec((1, s), lambda b, i: (b, 0))
+    bq, bk = _block(s, block_q), _block(s, block_k)
+    q_tile = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_tile = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    maskk = pl.BlockSpec((1, bk), lambda b, i, j: (b, j))
+    vec_q = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block_k=bk),
-        grid=(bh, s // bq),
-        in_specs=[qspec, qfull, qfull, vec_full, qspec, vec_q, vec_q],
-        out_specs=[qspec],
+        functools.partial(_dq_kernel, scale=scale),
+        grid=(bh, s // bq, s // bk),
+        in_specs=[q_tile, k_tile, k_tile, maskk, q_tile, vec_q, vec_q],
+        out_specs=[q_tile],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, mask, g, lse, delta)[0]
 
-    kspec = pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))
-    vec_k = pl.BlockSpec((1, bk), lambda b, j: (b, j))
+    # dk/dv: K tiles are the revisited outputs, Q is the accumulation axis
+    # (innermost grid dim), so swap the roles of the last two grid indices.
+    q_acc = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    k_out = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    maskk2 = pl.BlockSpec((1, bk), lambda b, j, i: (b, j))
+    vec_q2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=bq),
-        grid=(bh, s // bk),
-        in_specs=[qfull, kspec, kspec, vec_k, qfull, vec_full, vec_full],
-        out_specs=[kspec, kspec],
+        functools.partial(_dkv_kernel, scale=scale),
+        grid=(bh, s // bk, s // bq),
+        in_specs=[q_acc, k_out, k_out, maskk2, q_acc, vec_q2, vec_q2],
+        out_specs=[k_out, k_out],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, mask, g, lse, delta)
     return dq, dk, dv, None

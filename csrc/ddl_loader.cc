@@ -134,7 +134,9 @@ Crop random_resized_crop(std::mt19937_64& rng, int h, int w) {
 Crop center_crop(int h, int w, int target) {
   int shorter = std::min(h, w);
   int crop = (int)((float)target / (float)(target + 32) * (float)shorter);
-  crop = std::min(crop, shorter);
+  // >=1 guards degenerate (1-pixel-side) images from a zero-size crop,
+  // which would send negative indices into resize_bilinear.
+  crop = std::clamp(crop, 1, shorter);
   return Crop{(h - crop) / 2, (w - crop) / 2, crop, crop};
 }
 
@@ -209,10 +211,12 @@ struct DdlLoader {
   std::condition_variable cv_ready, cv_space;
   bool stop = false;
 
-  // Per-epoch shuffled order cache (epoch -> permutation of sample indices).
+  // Shuffled-order cache for the two most recent epochs: worker positions
+  // straddle an epoch boundary while it drains, and a single-entry cache
+  // would thrash a full O(n) reshuffle on every alternating lookup.
   std::mutex order_mu;
-  int64_t order_epoch = -1;
-  std::vector<int64_t> order;
+  int64_t order_epoch[2] = {-1, -1};
+  std::vector<int64_t> order_cache[2];
 
   int64_t n() const { return (int64_t)samples.size(); }
   int64_t batches_per_epoch() const { return n() / batch; }
@@ -221,17 +225,19 @@ struct DdlLoader {
   int64_t index_at(int64_t pos) {
     int64_t per_epoch = batches_per_epoch() * batch;  // drop remainder
     int64_t epoch = pos / per_epoch, off = pos % per_epoch;
+    int slot = (int)(epoch & 1);
     std::lock_guard<std::mutex> lk(order_mu);
-    if (epoch != order_epoch) {
+    if (order_epoch[slot] != epoch) {
+      auto& order = order_cache[slot];
       order.resize(n());
       std::iota(order.begin(), order.end(), 0);
       if (train) {
         std::mt19937_64 rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)epoch);
         std::shuffle(order.begin(), order.end(), rng);
       }
-      order_epoch = epoch;
+      order_epoch[slot] = epoch;
     }
-    return order[off];
+    return order_cache[slot][off];
   }
 
   void fill_sample(int64_t pos, Slot& slot, int32_t slot_off) {

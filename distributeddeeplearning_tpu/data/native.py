@@ -188,7 +188,7 @@ def make_native_source(config, sharding, *, train: bool = True,
     pidx, pcount = jax.process_index(), jax.process_count()
     paths = paths[pidx::pcount]
     labels = labels[pidx::pcount]
-    per_process = config.global_batch_size // pcount
+    per_process = imagenet._per_process_batch(config, pcount)
     loader = NativeImageLoader(
         paths, labels, batch_size=per_process, image_size=d.image_size,
         train=train, seed=config.seed, start_batch=start_step if train else 0)

@@ -20,13 +20,25 @@ def is_chief() -> bool:
 
 
 class MetricLogger:
-    """Rank-0 JSONL metric writer with wall-clock throughput accounting."""
+    """Rank-0 JSONL metric writer with wall-clock throughput accounting.
+
+    ``tensorboard_dir`` additionally mirrors every scalar into TF summaries
+    (the observability surface SURVEY.md §5.5 calls for); events are written
+    by tf's C++ writer thread, so the hot loop only pays a scalar enqueue.
+    """
 
     def __init__(self, stream: Optional[IO[str]] = None,
-                 file_path: Optional[str] = None, enabled: Optional[bool] = None):
+                 file_path: Optional[str] = None, enabled: Optional[bool] = None,
+                 tensorboard_dir: Optional[str] = None):
         self.stream = stream or sys.stdout
         self.file = open(file_path, "a") if file_path else None
         self.enabled = is_chief() if enabled is None else enabled
+        self._tb = None
+        if tensorboard_dir and self.enabled:
+            import tensorflow as tf
+
+            tf.config.set_visible_devices([], "GPU")
+            self._tb = tf.summary.create_file_writer(tensorboard_dir)
         self._last_time: Optional[float] = None
         self._last_step: Optional[int] = None
 
@@ -50,8 +62,17 @@ class MetricLogger:
             if self.file:
                 self.file.write(line + "\n")
                 self.file.flush()
+            if self._tb is not None:
+                import tensorflow as tf
+
+                with self._tb.as_default():
+                    for k, v in record.items():
+                        if k != "step" and isinstance(v, (int, float)):
+                            tf.summary.scalar(k, v, step=int(step))
         return record
 
     def close(self) -> None:
         if self.file:
             self.file.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -56,6 +56,18 @@ def test_metric_logger_jsonl(tmp_path):
     assert "examples_per_sec" in lines[1]
 
 
+def test_tensorboard_metric_mirror(tmp_path):
+    """tensorboard_dir mirrors scalars into TF event files (SURVEY.md §5.5)."""
+    tb = tmp_path / "tb"
+    lg = MetricLogger(tensorboard_dir=str(tb), enabled=True,
+                      stream=open("/dev/null", "w"))
+    lg.log(1, {"loss": 3.0}, examples_per_step=8)
+    lg.log(2, {"loss": 2.0}, examples_per_step=8)
+    lg.close()
+    events = list(tb.glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+
+
 def test_profiler_trace_capture(tmp_path):
     """profile_steps=(1,2) writes a jax.profiler trace dir (SURVEY.md §5.1)."""
     cfg = TrainConfig(model="resnet18", global_batch_size=8, dtype="float32",

@@ -67,6 +67,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(exercises checkpoint-resume; SURVEY.md §5.3)")
     p.add_argument("--checkpoint-every", type=int, default=None,
                    help="save a checkpoint every N steps")
+    p.add_argument("--tensorboard-dir", default=None,
+                   help="mirror metrics into TF summaries at this dir")
     return p.parse_args(argv)
 
 
@@ -185,10 +187,15 @@ def main(argv=None) -> int:
             1_281_167 // cfg.global_batch_size)  # ImageNet train split
         total_steps = int(cfg.num_epochs * steps_per_epoch)
 
+    logger = None
+    if args.tensorboard_dir:
+        from distributeddeeplearning_tpu.utils.logging import MetricLogger
+        logger = MetricLogger(tensorboard_dir=args.tensorboard_dir)
+
     summary = loop.run(cfg, total_steps=total_steps,
                        warmup_steps=min(args.warmup_steps, total_steps - 1)
                        if total_steps > 1 else 0,
-                       eval_batches=args.eval_batches)
+                       eval_batches=args.eval_batches, logger=logger)
     import jax
     if jax.process_index() == 0:
         print(json.dumps({"summary": summary}), flush=True)

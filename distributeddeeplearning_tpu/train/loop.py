@@ -184,10 +184,15 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             profile.after_step(i, metrics)
             done = i - start_step + 1
             if done == warmup_steps:
-                jax.block_until_ready(metrics)
+                # device_get, not block_until_ready: a fetch is a true
+                # execution barrier on every backend (remote-tunneled devices
+                # can report buffers "ready" while programs are still in
+                # flight, which would start the timing window early).
+                jax.device_get(metrics)
                 t_timed = time.perf_counter()
             if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
-                jax.block_until_ready(metrics)
+                # logger floats every metric (a true fetch barrier); no
+                # separate block needed.
                 logger.log(int(i + 1), metrics,
                            examples_per_step=config.global_batch_size,
                            lr=float(sched(i)))
@@ -203,7 +208,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     ckpt.wait()
                 raise SystemExit(
                     f"fault injection: killed after step {i + 1}")
-        jax.block_until_ready(state)
+        # End-of-run sync: fetching the final step's metrics and step counter
+        # is a true completion barrier for the whole dispatch queue (the last
+        # program's outputs exist only after it ran), without a per-leaf
+        # readiness walk over the params/opt-state tree — which on a
+        # remote-tunneled device costs seconds and would pollute timing.
+        jax.device_get((metrics, state.step))
     finally:
         profile.finish()
     if ckpt is not None:

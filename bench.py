@@ -12,26 +12,35 @@ per-chip: 8xV100 ResNet50 ImageNet aggregate on a v5e-8, i.e. one V100's
 mixed-precision throughput per chip. We pin that at 1450 images/sec/chip
 (NVIDIA's commonly-published V100 ResNet50 AMP figure); vs_baseline > 1.0
 means beating the target.
+
+Resilience contract (VERDICT.md round 1, Missing #1): backend init against
+the remote TPU can hang or raise transient ``UNAVAILABLE``.  The measurement
+therefore runs in a *child* process under a hard per-attempt timeout, with
+bounded retries + backoff in the parent; whatever happens, the parent prints
+exactly one parseable JSON line (a numeric record on success, an ``error``
+record otherwise) and exits 0.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import time
 
 V100_AMP_RESNET50_IMAGES_PER_SEC = 1450.0
+RETRY_BACKOFF_SEC = (10, 30)  # sleeps between the 3 attempts
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50")
-    p.add_argument("--batch-size", type=int, default=256)
-    p.add_argument("--steps", type=int, default=30)
-    p.add_argument("--warmup-steps", type=int, default=10)
-    args = p.parse_args(argv)
-
+def _child(args) -> int:
+    """Run the actual measurement; prints the one JSON metric line."""
     import jax
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        jax.config.update("jax_platforms", args.platform)
 
     from distributeddeeplearning_tpu.config import (
         DataConfig, ParallelConfig, TrainConfig)
@@ -59,6 +68,81 @@ def main(argv=None) -> int:
         "unit": "images/sec/chip",
         "vs_baseline": round(value / V100_AMP_RESNET50_IMAGES_PER_SEC, 4),
     }), flush=True)
+    return 0
+
+
+def _emit_error(args, msg: str) -> None:
+    print(json.dumps({
+        "metric": f"{args.model}_imagenet_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": msg[-800:],
+    }), flush=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup-steps", type=int, default=10)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu) for smoke runs")
+    p.add_argument("--attempt-timeout", type=int, default=600,
+                   help="hard wall-clock limit per measurement attempt (s)")
+    p.add_argument("--attempts", type=int, default=3)
+    p.add_argument("--budget", type=int, default=1200,
+                   help="total wall-clock budget across all attempts (s); "
+                        "guarantees the error record is printed before any "
+                        "outer driver timeout can strike")
+    p.add_argument("--run-child", action="store_true", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.run_child:
+        return _child(args)
+
+    child_cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
+                 "--model", args.model,
+                 "--batch-size", str(args.batch_size),
+                 "--steps", str(args.steps),
+                 "--warmup-steps", str(args.warmup_steps)]
+    if args.platform:
+        child_cmd += ["--platform", args.platform]
+
+    last_err = "no attempt ran"
+    deadline = time.monotonic() + args.budget
+    for attempt in range(args.attempts):
+        if attempt:
+            time.sleep(RETRY_BACKOFF_SEC[min(attempt - 1,
+                                             len(RETRY_BACKOFF_SEC) - 1)])
+        remaining = deadline - time.monotonic()
+        if remaining < 30:
+            last_err += "; budget exhausted"
+            break
+        try:
+            proc = subprocess.run(
+                child_cmd, capture_output=True, text=True,
+                timeout=min(args.attempt_timeout, remaining))
+        except subprocess.TimeoutExpired:
+            last_err = (f"attempt {attempt + 1}: timed out after "
+                        f"{min(args.attempt_timeout, int(remaining))}s "
+                        f"(backend hang?)")
+            continue
+        # Find the metric line: last stdout line that parses as JSON.
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    json.loads(line)
+                except ValueError:
+                    continue
+                print(line, flush=True)
+                return 0
+        tail = (proc.stderr or proc.stdout or "").strip()
+        last_err = f"attempt {attempt + 1}: rc={proc.returncode}: {tail[-600:]}"
+
+    _emit_error(args, last_err)
     return 0
 
 

@@ -33,6 +33,17 @@ def _dtype(config: TrainConfig):
     return jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
 
+def steps_per_epoch(config: TrainConfig) -> Optional[int]:
+    """Explicit ``config.steps_per_epoch``, else derived from the dataset's
+    train-split size (ImageNet: 1,281,167), else None (step-based runs)."""
+    if config.steps_per_epoch:
+        return config.steps_per_epoch
+    if config.data.dataset == "imagenet":
+        from distributeddeeplearning_tpu.data.imagenet import TRAIN_SPLIT_SIZE
+        return max(TRAIN_SPLIT_SIZE // config.global_batch_size, 1)
+    return None
+
+
 def uses_gspmd(config: TrainConfig, input_kind: str) -> bool:
     """Transformers (or any config with tp/sp/fsdp axes) take the GSPMD path;
     pure-DP CNNs take the explicit shard_map+psum path."""
@@ -65,7 +76,15 @@ def build(config: TrainConfig, total_steps: int):
 
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,
-        config.steps_per_epoch)
+        steps_per_epoch(config))
+    if (spec.input_kind == "image" and config.grad_accum_steps > 1
+            and config.per_device_batch // config.grad_accum_steps < 32
+            and jax.process_index() == 0):
+        print(f"# warning: BatchNorm statistics will be computed over only "
+              f"{config.per_device_batch // config.grad_accum_steps} examples "
+              f"per microbatch (per_device_batch={config.per_device_batch}, "
+              f"grad_accum_steps={config.grad_accum_steps}); consider "
+              f"lowering --accum", file=sys.stderr, flush=True)
     rng = jax.random.key(config.seed)
 
     seq_dim = 1 if spec.input_kind == "tokens" else None
@@ -172,6 +191,19 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
               + (f" | resumed@{start_step}" if start_step else ""),
               file=sys.stderr, flush=True)
 
+    # Periodic in-training eval (SURVEY.md §3.5: "train N epochs → periodic
+    # eval → top-1"). eval_batches > 0 enables it; cadence is
+    # config.eval_every_epochs converted to steps.
+    evaluator = None
+    eval_every_steps = 0
+    evals: list[tuple[int, float]] = []
+    if eval_batches > 0 and spec.input_kind == "image":
+        evaluator = _Evaluator(config, mesh, model, batch_shd, eval_batches)
+        if config.eval_every_epochs > 0:
+            spe = steps_per_epoch(config)
+            if spe is not None:
+                eval_every_steps = max(int(config.eval_every_epochs * spe), 1)
+
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
@@ -200,6 +232,16 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 timed_examples += config.global_batch_size
             if ckpt is not None:
                 ckpt.maybe_save(i + 1, state)
+            if (eval_every_steps and (i + 1) % eval_every_steps == 0
+                    and i + 1 < total_steps):
+                t_eval = time.perf_counter()
+                top1 = evaluator(state)
+                evals.append((i + 1, top1))
+                logger.log(int(i + 1), {"eval_top1": top1})
+                if t_timed is not None:
+                    # Keep throughput numbers about training: shift the
+                    # timing origin past the eval pause.
+                    t_timed += time.perf_counter() - t_eval
             if config.fail_at_step is not None and i + 1 == config.fail_at_step:
                 # Fault injection (SURVEY.md §5.3): die like a preempted host
                 # so the launcher's fail-whole path + checkpoint-resume get
@@ -233,10 +275,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             summary["examples_per_sec"] / jax.device_count())
         summary["steps_per_sec"] = (
             total_steps - start_step - warmup_steps) / elapsed
-    if eval_batches > 0 and spec.input_kind == "image":
-        summary["eval_top1"] = evaluate(
-            config, mesh, model, state, batch_shd, eval_batches,
-            first_step=end_step)
+    if evaluator is not None:
+        final_top1 = evaluator(state)
+        evals.append((end_step, final_top1))
+        summary["eval_top1"] = final_top1
+        summary["best_top1"] = max(t for _, t in evals)
+        summary["evals"] = evals
     if return_state:
         summary["state"] = state
     return summary
@@ -281,26 +325,44 @@ class _Profiler:
               file=sys.stderr, flush=True)
 
 
-def evaluate(config: TrainConfig, mesh, model, state, batch_shd,
-             num_batches: int, *, first_step: int = 0) -> float:
+class _Evaluator:
     """Sharded top-1 over ``num_batches``: per-shard correct counts are
     psummed across the DP axes before dividing (SURVEY.md §3.5), so the
     result is identical to a single-device pass over the global batch.
 
-    Real data mode reads the validation split (center-crop pipeline);
-    synthetic mode offsets the deterministic source by ``first_step`` so eval
-    batches don't replay training batches.
+    Built once per run — the compiled eval step is reused across every
+    periodic (epoch-boundary) and final invocation. The synthetic source is
+    indexable and also reused; a real validation split is a *finite ordered
+    stream*, so a fresh source is built per invocation (each eval reads the
+    split from its start).
+
+    Synthetic mode evaluates at a fixed huge batch-index offset
+    (``SYNTHETIC_EVAL_OFFSET``), disjoint from any training step index, so
+    eval batches never replay training batches and every eval scores the
+    same held-out set.
     """
-    eval_step = steps.make_dp_eval_step(model, mesh, config)
-    if config.data.synthetic or not config.data.data_dir:
-        source, offset = datalib.make_source(
-            config, "image", batch_shd), first_step
-    else:
-        source, offset = datalib.make_source(
-            config, "image", batch_shd, train=False), 0
-    correct = total = 0
-    for j in range(num_batches):
-        counts = eval_step(state, source.batch(offset + j))
-        correct += int(jax.device_get(counts["correct"]))
-        total += int(jax.device_get(counts["total"]))
-    return correct / max(total, 1)
+
+    SYNTHETIC_EVAL_OFFSET = 1 << 30
+
+    def __init__(self, config: TrainConfig, mesh, model, batch_shd,
+                 num_batches: int):
+        self.num_batches = num_batches
+        self.eval_step = steps.make_dp_eval_step(model, mesh, config)
+        self.synthetic = config.data.synthetic or not config.data.data_dir
+        self._config, self._batch_shd = config, batch_shd
+        self._synth_source = (
+            datalib.make_source(config, "image", batch_shd)
+            if self.synthetic else None)
+
+    def __call__(self, state) -> float:
+        if self.synthetic:
+            source, offset = self._synth_source, self.SYNTHETIC_EVAL_OFFSET
+        else:
+            source, offset = datalib.make_source(
+                self._config, "image", self._batch_shd, train=False), 0
+        correct = total = 0
+        for j in range(self.num_batches):
+            counts = self.eval_step(state, source.batch(offset + j))
+            correct += int(jax.device_get(counts["correct"]))
+            total += int(jax.device_get(counts["total"]))
+        return correct / max(total, 1)

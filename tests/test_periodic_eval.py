@@ -1,0 +1,52 @@
+"""Periodic in-training eval (VERDICT r1 #4; SURVEY.md §3.5): eval fires at
+epoch boundaries per ``eval_every_epochs``, the summary tracks ``best_top1``,
+and the metric is logged through MetricLogger."""
+
+import io
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu.config import (
+    DataConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.train import loop
+from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+
+def _cfg(**kw):
+    base = dict(
+        model="resnet18", global_batch_size=16, dtype="float32",
+        log_every=10**9, steps_per_epoch=4, eval_every_epochs=1.0,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(synthetic=True, image_size=16, num_classes=10))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_eval_fires_at_epoch_boundaries():
+    stream = io.StringIO()
+    logger = MetricLogger(stream=stream, enabled=True)
+    summary = loop.run(_cfg(), total_steps=10, eval_batches=1, logger=logger)
+    # steps_per_epoch=4, eval_every_epochs=1 → evals at 4, 8, final@10.
+    assert [s for s, _ in summary["evals"]] == [4, 8, 10]
+    assert summary["best_top1"] == max(t for _, t in summary["evals"])
+    assert summary["eval_top1"] == summary["evals"][-1][1]
+    logged = [json.loads(l) for l in stream.getvalue().splitlines()]
+    eval_steps = [r["step"] for r in logged if "eval_top1" in r]
+    assert eval_steps == [4, 8]  # the final eval lands in the summary only
+
+
+@pytest.mark.usefixtures("devices8")
+def test_eval_every_epochs_zero_means_final_only():
+    summary = loop.run(_cfg(eval_every_epochs=0.0), total_steps=10,
+                       eval_batches=1)
+    assert [s for s, _ in summary["evals"]] == [10]
+    assert "best_top1" in summary
+
+
+@pytest.mark.usefixtures("devices8")
+def test_multi_epoch_cadence():
+    summary = loop.run(_cfg(eval_every_epochs=2.0), total_steps=9,
+                       eval_batches=1)
+    assert [s for s, _ in summary["evals"]] == [8, 9]

@@ -38,6 +38,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="on-device synthetic data (config 1)")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--dp", type=int, default=None, help="data-parallel size")
+    p.add_argument("--accum", type=int, default=None,
+                   help="gradient-accumulation microbatches per optimizer "
+                        "step (config 5's batch=32k on small meshes)")
     p.add_argument("--fsdp", type=int, default=None)
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
@@ -102,6 +105,10 @@ def build_config(args: argparse.Namespace):
             raise SystemExit(
                 f"--checkpoint-every must be positive (got {args.checkpoint_every})")
         cfg = cfg.replace(checkpoint_every_steps=args.checkpoint_every)
+    if args.accum is not None:
+        if args.accum <= 0:
+            raise SystemExit(f"--accum must be positive (got {args.accum})")
+        cfg = cfg.replace(grad_accum_steps=args.accum)
     cfg = cfg.replace(backend=args.backend)
     if args.profile_steps:
         try:
@@ -183,8 +190,11 @@ def main(argv=None) -> int:
             # an explicit step budget rather than inventing one.
             raise SystemExit(
                 "token models have no epoch semantics; pass --steps")
-        steps_per_epoch = cfg.steps_per_epoch or (
-            1_281_167 // cfg.global_batch_size)  # ImageNet train split
+        steps_per_epoch = loop.steps_per_epoch(cfg)
+        if steps_per_epoch is None:
+            raise SystemExit(
+                f"dataset {cfg.data.dataset!r} has no known epoch size; "
+                "pass --steps or set steps_per_epoch in the config")
         total_steps = int(cfg.num_epochs * steps_per_epoch)
 
     logger = None

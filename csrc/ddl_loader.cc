@@ -58,9 +58,10 @@ struct Image {
 
 // Decode with DCT scaling: libjpeg can decode at 1/2, 1/4, 1/8 resolution
 // almost for free; pick the largest reduction that keeps both sides >=
-// 2*target (preserves crop/resize quality while cutting IDCT work — the
-// cheap half of DALI's fused decode-and-crop trick).
-Image decode_jpeg(const uint8_t* buf, size_t len, int target) {
+// min_side (preserves crop/resize quality while cutting IDCT work — the
+// cheap half of DALI's fused decode-and-crop trick). The caller picks
+// min_side so the smallest crop it will take is never upsampled.
+Image decode_jpeg(const uint8_t* buf, size_t len, int min_side) {
   Image img;
   jpeg_decompress_struct cinfo;
   JpegErr err;
@@ -77,10 +78,10 @@ Image decode_jpeg(const uint8_t* buf, size_t len, int target) {
   cinfo.dct_method = JDCT_IFAST;
   cinfo.scale_num = 1;
   cinfo.scale_denom = 1;
-  if (target > 0) {
+  if (min_side > 0) {
     while (cinfo.scale_denom < 8 &&
-           (int)cinfo.image_width / (int)(cinfo.scale_denom * 2) >= 2 * target &&
-           (int)cinfo.image_height / (int)(cinfo.scale_denom * 2) >= 2 * target) {
+           (int)cinfo.image_width / (int)(cinfo.scale_denom * 2) >= min_side &&
+           (int)cinfo.image_height / (int)(cinfo.scale_denom * 2) >= min_side) {
       cinfo.scale_denom *= 2;
     }
   }
@@ -254,7 +255,15 @@ struct DdlLoader {
         std::fseek(f, 0, SEEK_SET);
         std::vector<uint8_t> buf((size_t)std::max(len, 0L));
         if (len > 0 && std::fread(buf.data(), 1, (size_t)len, f) == (size_t)len) {
-          img = decode_jpeg(buf.data(), buf.size(), image_size);
+          // Train: the smallest random-resized crop is 8% area at 4:3
+          // aspect, i.e. a shorter side of sqrt(0.08/(4/3)) ~= 0.245x the
+          // image — bound DCT scaling so even that crop decodes at >=
+          // target resolution (no upsampling softening the augmentation
+          // distribution — ADVICE r1 #3). Eval center-crops ~0.875x, so
+          // 2*target keeps its long-standing margin.
+          int min_side = train ? (int)std::ceil(image_size / 0.244f)
+                               : 2 * image_size;
+          img = decode_jpeg(buf.data(), buf.size(), min_side);
         }
         std::fclose(f);
       }

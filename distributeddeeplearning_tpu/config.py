@@ -56,7 +56,9 @@ class DataConfig:
     image_size: int = 224
     num_classes: int = 1000
     shuffle_buffer: int = 16384
-    prefetch_depth: int = 2       # device-side double buffering
+    prefetch_depth: int = 2       # StreamSource lookahead batches (host->HBM
+                                  # pipelining; also the native loader's
+                                  # batch-slot ring depth - 1)
     # BERT-style sequence workloads:
     seq_len: int = 128
     vocab_size: int = 30522
@@ -89,7 +91,9 @@ class TrainConfig:
     """Top-level run description — one per acceptance config."""
 
     model: str = "resnet50"
-    backend: str = "tpu"          # tpu | cpu (BASELINE.json:5)
+    backend: str = "tpu"          # tpu | cpu (BASELINE.json:5); "cpu" forces
+                                  # the mesh onto host CPU devices even when
+                                  # an accelerator platform is active
     global_batch_size: int = 32   # config 1 default (BASELINE.json:7)
     num_epochs: float = 90.0
     steps_per_epoch: Optional[int] = None  # derived from dataset if None

@@ -19,6 +19,32 @@ from distributeddeeplearning_tpu.data.synthetic import (  # noqa: F401
 )
 
 
+def resolve_loader(config: TrainConfig, input_kind: str) -> str:
+    """Resolve ``config.data.loader`` to the concrete pipeline that will run.
+
+    Returns one of ``synthetic | tokens | tf | native``. ``auto`` resolution
+    is environment-dependent (C++ toolchain, DDL_NATIVE_LOADER) and the tf /
+    native pipelines shuffle differently, so the resolved value is part of a
+    run's determinism contract: the loop logs it at startup and persists it
+    in checkpoint metadata so a resume under a different resolution fails
+    loudly instead of silently switching sample streams (ADVICE r1 #1).
+    """
+    d = config.data
+    if d.synthetic or not d.data_dir:
+        return "synthetic"
+    if input_kind == "tokens":
+        return "tokens"
+    loader = d.loader
+    if loader == "auto":
+        from distributeddeeplearning_tpu.data import imagenet, native
+        # The C++ loader owns image-folder layouts when it can build;
+        # TFRecords stay on tf.data (its native record readers).
+        loader = ("native"
+                  if (imagenet.detect_layout(d.data_dir) == "folder"
+                      and native.available()) else "tf")
+    return loader
+
+
 def make_source(config: TrainConfig, input_kind: str,
                 sharding: Optional[jax.sharding.Sharding] = None, *,
                 start_step: int = 0, train: bool = True):
@@ -30,23 +56,17 @@ def make_source(config: TrainConfig, input_kind: str,
       sharded per process, streamed from ``start_step``;
     - tokens + data_dir: packed-token MLM pipeline (data/tokens.py).
     """
-    d = config.data
-    if d.synthetic or not d.data_dir:
+    loader = resolve_loader(config, input_kind)
+    if loader == "synthetic":
         return synthetic.make_source(config, input_kind, sharding=sharding)
-    if input_kind == "tokens":
+    if loader == "tokens":
         from distributeddeeplearning_tpu.data import tokens
         return tokens.make_token_source(
             config, sharding, start_step=start_step, train=train)
-    from distributeddeeplearning_tpu.data import imagenet, native
-    loader = d.loader
-    if loader == "auto":
-        # The C++ loader owns image-folder layouts when it can build;
-        # TFRecords stay on tf.data (its native record readers).
-        loader = ("native"
-                  if (imagenet.detect_layout(d.data_dir) == "folder"
-                      and native.available()) else "tf")
     if loader == "native":
+        from distributeddeeplearning_tpu.data import native
         return native.make_native_source(
             config, sharding, train=train, start_step=start_step)
+    from distributeddeeplearning_tpu.data import imagenet
     return imagenet.make_imagenet_source(
         config, sharding, train=train, start_step=start_step)

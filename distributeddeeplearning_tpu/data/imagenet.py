@@ -258,22 +258,32 @@ class StreamSource:
 
     Each pulled host batch becomes a *global* jax.Array via
     ``make_array_from_process_local_data`` with the mesh batch sharding —
-    per-process shards go straight to their local devices' HBM. A one-deep
-    lookahead buffer keeps host decode of step k+1 running while the device
-    executes step k (the "device-side HBM prefetch" of BASELINE.json:5; the
-    deeper pipelining lives inside tf.data's prefetch + the jitted step's
-    async dispatch).
+    per-process shards go straight to their local devices' HBM. A
+    ``depth``-deep lookahead buffer (``DataConfig.prefetch_depth``; 1 =
+    double buffering) keeps host decode of steps k+1..k+depth in flight
+    while the device executes step k — the "device-side HBM prefetch" of
+    BASELINE.json:5; deeper pipelining lives inside tf.data's prefetch + the
+    jitted step's async dispatch.
     """
 
     _EXHAUSTED = object()
 
     def __init__(self, it: Iterator[dict], sharding, *, first_step: int = 0,
-                 lookahead: bool = True):
+                 lookahead: bool = True, depth: int = 1):
         self._it = it
         self._sharding = sharding
         self._next_step = first_step
-        self._lookahead = lookahead
-        self._pending = self._pull() if lookahead else None
+        # depth <= 0 (or lookahead=False) disables prefetch entirely —
+        # batches are pulled on demand (used by short bounded evals).
+        self._depth = max(depth, 0) if lookahead else 0
+        self._pending: list = []
+        self._fill()
+
+    def _fill(self) -> None:
+        while (len(self._pending) < self._depth
+               and not (self._pending
+                        and self._pending[-1] is self._EXHAUSTED)):
+            self._pending.append(self._pull())
 
     def _pull(self):
         """Next device batch, or the _EXHAUSTED sentinel on a finite stream
@@ -306,8 +316,9 @@ class StreamSource:
                 f"expected {self._next_step} (resume must rebuild the source "
                 "with first_step=start_step)")
         self._next_step += 1
-        if self._lookahead:
-            out, self._pending = self._pending, self._pull()
+        if self._depth:
+            out = self._pending.pop(0)
+            self._fill()
         else:
             out = self._pull()
         if out is self._EXHAUSTED:
@@ -319,4 +330,5 @@ def make_imagenet_source(config: TrainConfig, sharding, *, train: bool = True,
                          start_step: int = 0) -> StreamSource:
     ds = build_dataset(config, train=train, start_step=start_step)
     return StreamSource(ds.as_numpy_iterator(), sharding,
-                        first_step=start_step)
+                        first_step=start_step,
+                        depth=config.data.prefetch_depth)

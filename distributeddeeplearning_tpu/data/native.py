@@ -118,7 +118,16 @@ class NativeImageLoader:
 
         lib = _load()
         n = len(paths)
-        assert n == len(labels) and n >= batch_size
+        if n != len(labels):
+            raise ValueError(
+                f"paths/labels length mismatch: {n} vs {len(labels)}")
+        if n < batch_size:
+            raise ValueError(
+                f"native loader needs at least one full batch: have {n} "
+                f"samples but batch_size={batch_size}. With multi-process "
+                f"sharding a small split can shrink below the per-process "
+                f"batch — lower the batch size, or use loader='tf' "
+                f"(tf.data drops the remainder instead).")
         self._lib = lib
         self._batch = batch_size
         self._size = image_size
@@ -191,7 +200,8 @@ def make_native_source(config, sharding, *, train: bool = True,
     per_process = imagenet._per_process_batch(config, pcount)
     loader = NativeImageLoader(
         paths, labels, batch_size=per_process, image_size=d.image_size,
-        train=train, seed=config.seed, start_batch=start_step if train else 0)
+        train=train, seed=config.seed, start_batch=start_step if train else 0,
+        queue_depth=max(d.prefetch_depth + 1, 2))
 
     it = iter(loader)
     if config.dtype == "bfloat16":
@@ -201,6 +211,7 @@ def make_native_source(config, sharding, *, train: bool = True,
             return {"image": b["image"].astype(jnp.bfloat16),
                     "label": b["label"]}
         it = (cast(b) for b in it)
-    src = imagenet.StreamSource(it, sharding, first_step=start_step)
+    src = imagenet.StreamSource(it, sharding, first_step=start_step,
+                                depth=d.prefetch_depth)
     src._native_loader = loader  # keep alive; closed on GC
     return src

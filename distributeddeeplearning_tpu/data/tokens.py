@@ -114,4 +114,5 @@ def _batch_stream(config: TrainConfig, *, train: bool,
 def make_token_source(config: TrainConfig, sharding, *, start_step: int = 0,
                       train: bool = True) -> StreamSource:
     it = _batch_stream(config, train=train, start_step=start_step)
-    return StreamSource(it, sharding, first_step=start_step)
+    return StreamSource(it, sharding, first_step=start_step,
+                        depth=config.data.prefetch_depth)

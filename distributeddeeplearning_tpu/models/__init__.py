@@ -45,10 +45,31 @@ def _registry() -> dict[str, ModelSpec]:
         "bert_large": ModelSpec(
             name="bert_large", build=bert.bert_large_mlm, input_kind="tokens",
             param_count=335_174_458),
+        # BERT-base with a top-1-routed 8-expert MoE FFN every other layer
+        # (models/moe.py), expert-parallel over the `expert` mesh axis.
+        "bert_base_moe": ModelSpec(
+            name="bert_base_moe",
+            build=lambda **kw: bert.bert_base_mlm(num_experts=8, **kw),
+            input_kind="tokens", param_count=0),
         # Test/dry-run sized transformer; param_count=0 means "unchecked".
         "bert_tiny": ModelSpec(
             name="bert_tiny", build=bert.tiny_bert_mlm, input_kind="tokens",
             param_count=0),
+        "bert_tiny_moe": ModelSpec(
+            name="bert_tiny_moe",
+            build=lambda **kw: bert.tiny_bert_mlm(num_experts=4, **kw),
+            input_kind="tokens", param_count=0),
+        # BERT-base as a 4-stage GPipe pipeline over the `pipeline` axis.
+        "bert_base_pp": ModelSpec(
+            name="bert_base_pp",
+            build=lambda **kw: bert.bert_base_mlm(
+                pipeline_stages=4, pipeline_microbatches=8, **kw),
+            input_kind="tokens", param_count=0),
+        "bert_tiny_pp": ModelSpec(
+            name="bert_tiny_pp",
+            build=lambda **kw: bert.tiny_bert_mlm(
+                pipeline_stages=2, pipeline_microbatches=4, **kw),
+            input_kind="tokens", param_count=0),
     }
 
 

@@ -39,6 +39,19 @@ class BertConfig:
     # Like flash kernels, "ring" skips attention-probability dropout (the
     # probs are never materialized); all other dropouts apply unchanged.
     attention_impl: str = "dense"
+    # Mixture-of-Experts: num_experts > 0 replaces the FFN of every
+    # ``moe_every``-th layer with a top-1-routed expert MLP (models/moe.py),
+    # expert-parallel over the ``expert`` mesh axis.
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # Pipeline parallelism: pipeline_stages > 1 runs the encoder stack as a
+    # GPipe schedule over the ``pipeline`` mesh axis (models/pipeline.py);
+    # num_layers must divide evenly into stages. Incompatible with MoE
+    # layers (the stages must be homogeneous).
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
 
 def _dense(features, logical_axes, name, dtype, use_bias=True):
@@ -68,6 +81,16 @@ class SelfAttention(nn.Module):
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
         # BertMLM always materializes a bool attention_mask before calling in.
+        if (cfg.attention_impl != "dense" and cfg.dropout_rate > 0
+                and not deterministic):
+            # Runs at trace time — once per compile, not per step.
+            import warnings
+            warnings.warn(
+                f"attention_impl={cfg.attention_impl!r} does not apply "
+                f"attention-probability dropout (the probs are never "
+                f"materialized); training regularization differs from "
+                f"'dense' at dropout_rate={cfg.dropout_rate}. Residual/MLP "
+                f"dropouts still apply.", UserWarning, stacklevel=2)
         if cfg.attention_impl == "ring":
             from distributeddeeplearning_tpu.parallel import ring_attention
             out = ring_attention.ring_attention_sharded(
@@ -98,6 +121,7 @@ class SelfAttention(nn.Module):
 class EncoderLayer(nn.Module):
     cfg: BertConfig
     dtype: Dtype
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, mask, *, deterministic: bool):
@@ -107,10 +131,20 @@ class EncoderLayer(nn.Module):
         attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="attention_ln")(x + attn)
-        h = _dense(cfg.intermediate_size, ("embed", "mlp"), "intermediate",
-                   self.dtype)(x)
-        h = nn.gelu(h, approximate=False)
-        h = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_output", self.dtype)(h)
+        if self.use_moe:
+            from distributeddeeplearning_tpu.models.moe import MoeMlp
+            h = MoeMlp(hidden_size=cfg.hidden_size,
+                       intermediate_size=cfg.intermediate_size,
+                       num_experts=cfg.num_experts,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       dtype=self.dtype, name="moe_mlp")(
+                           x, deterministic=deterministic)
+        else:
+            h = _dense(cfg.intermediate_size, ("embed", "mlp"), "intermediate",
+                       self.dtype)(x)
+            h = nn.gelu(h, approximate=False)
+            h = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_output",
+                       self.dtype)(h)
         h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                             param_dtype=jnp.float32, name="mlp_ln")(x + h)
@@ -163,10 +197,35 @@ class BertMLM(nn.Module):
         # Sequence-parallel hint: activations shard (data, seq, -).
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
-        for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, self.dtype, name=f"layer{i}")(
-                x, attention_mask, deterministic=deterministic)
+        if cfg.pipeline_stages > 1:
+            from distributeddeeplearning_tpu.models.pipeline import (
+                PipelinedEncoder)
+            if cfg.num_layers % cfg.pipeline_stages:
+                raise ValueError(
+                    f"num_layers={cfg.num_layers} not divisible by "
+                    f"pipeline_stages={cfg.pipeline_stages}")
+            if cfg.num_experts > 0:
+                raise ValueError(
+                    "pipeline_stages > 1 requires homogeneous layers; "
+                    "disable MoE (num_experts=0)")
+            import functools
+            x = PipelinedEncoder(
+                layer_factory=functools.partial(
+                    EncoderLayer, cfg, self.dtype),
+                num_stages=cfg.pipeline_stages,
+                layers_per_stage=cfg.num_layers // cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches,
+                dtype=self.dtype, name="pipeline")(
+                    x, attention_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        else:
+            for i in range(cfg.num_layers):
+                use_moe = (cfg.num_experts > 0
+                           and i % cfg.moe_every == cfg.moe_every - 1)
+                x = EncoderLayer(cfg, self.dtype, use_moe=use_moe,
+                                 name=f"layer{i}")(
+                    x, attention_mask, deterministic=deterministic)
+                x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         # MLM head: transform -> LayerNorm -> tied decoder + bias.
         h = _dense(cfg.hidden_size, ("embed", "embed_out"), "mlm_transform",

@@ -1,0 +1,113 @@
+"""Pipeline parallelism over the ``pipeline`` mesh axis (wires
+ParallelConfig.pipeline — VERDICT r1 "dead config" item).
+
+TPU-first design (the GSPMD pipelining pattern used by production JAX LLM
+stacks): instead of per-stage processes exchanging activations (the
+GPU/NCCL shape of pipeline parallelism), the whole GPipe schedule is ONE
+XLA program —
+
+- encoder layers are created with ``nn.vmap``(stages) of ``nn.scan``(layers
+  per stage), so every layer parameter has a leading ``(num_stages,
+  layers_per_stage, ...)`` block whose stage dim carries the ``layers``
+  logical axis -> ``pipeline`` mesh axis (parallel/sharding.py);
+- a ``(num_stages, microbatch, S, H)`` state buffer holds the activation
+  each stage is working on, sharded over ``pipeline`` on dim 0;
+- each schedule tick applies all stages at once (the vmapped chunk — each
+  stage's compute lands on that stage's devices) and then *shifts* the
+  buffer one stage forward, injecting the next microbatch at stage 0. XLA
+  lowers the shift of a pipeline-sharded buffer to a ``collective-permute``
+  over ICI — the TPU-native replacement for point-to-point activation sends.
+
+The classic GPipe bubble (stages idle for P-1 of the M+P-1 ticks) applies;
+choose ``num_microbatches >> num_stages`` to amortize it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class _LayerStep(nn.Module):
+    """scan body: carry=(x, mask) -> one encoder layer applied."""
+
+    layer_factory: Callable[..., nn.Module]
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, mask = carry
+        x = self.layer_factory(name="layer")(
+            x, mask, deterministic=self.deterministic)
+        return (x, mask), None
+
+
+class PipelinedEncoder(nn.Module):
+    """Runs ``num_stages * layers_per_stage`` transformer layers as a GPipe
+    pipeline. ``layer_factory(name=...)`` must build one encoder layer
+    module with signature (x, mask, deterministic=...) -> x — e.g. a partial
+    of bert.EncoderLayer.
+    """
+
+    layer_factory: Callable[..., nn.Module]
+    num_stages: int
+    layers_per_stage: int
+    num_microbatches: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic: bool):
+        p, m = self.num_stages, self.num_microbatches
+        b, s, h = x.shape
+        if b % m:
+            raise ValueError(
+                f"batch {b} not divisible by num_microbatches={m}")
+        mb = b // m
+
+        # Inner: scan over one stage's layers (params stacked on the
+        # replicated "layers_chunk" dim). Outer: vmap over stages (params
+        # and activations stacked on "layers" -> `pipeline` mesh axis).
+        chunk = nn.scan(
+            _LayerStep,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=self.layers_per_stage,
+            metadata_params={nn.PARTITION_NAME: "layers_chunk"})
+        stages_cls = nn.vmap(
+            chunk,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=((0, 0), None), out_axes=((0, 0), None),
+            metadata_params={nn.PARTITION_NAME: "layers"})
+        stages = stages_cls(self.layer_factory, deterministic, name="stages")
+
+        micro = x.reshape(m, mb, s, h)
+        micro_mask = mask.reshape(m, mb, s)
+        state = jnp.zeros((p, mb, s, h), x.dtype)
+        state_mask = jnp.ones((p, mb, s), mask.dtype)
+        zeros_in = jnp.zeros_like(micro[0])
+
+        outputs = []
+        # M + P - 1 schedule ticks; the Python loop is static and short, and
+        # keeps stage-0 injection a pure concatenate.
+        for t in range(m + p - 1):
+            inject = micro[t] if t < m else zeros_in
+            inject_mask = micro_mask[t] if t < m else micro_mask[m - 1]
+            # Shift the pipeline: stage k takes stage k-1's output; stage 0
+            # takes the next microbatch. XLA: collective-permute over ICI.
+            state = jnp.concatenate([inject[None], state[:-1]], axis=0)
+            state_mask = jnp.concatenate(
+                [inject_mask[None], state_mask[:-1]], axis=0)
+            state = nn.with_logical_constraint(
+                state, ("layers", "batch", "seq", "embed"))
+            (state, state_mask), _ = stages((state, state_mask), None)
+            if t >= p - 1:
+                # Stage P-1 just finished microbatch t - (P-1).
+                outputs.append(state[-1])
+
+        out = jnp.concatenate(outputs, axis=0)  # (M*mb, S, H), in order
+        return out.reshape(b, s, h)

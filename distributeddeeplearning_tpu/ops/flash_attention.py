@@ -59,14 +59,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)                      # (BQ, D)
-    k = k_ref[0].astype(jnp.float32)                      # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    msk = mask_ref[0] != 0                                # (BK,)
+    # Matmul operands stay in their storage dtype (bf16 on the training
+    # path): the MXU takes bf16 inputs at full rate with f32 accumulation
+    # via preferred_element_type — upcasting first would halve MXU
+    # throughput and double VMEM traffic for zero precision gain.
+    q = q_ref[0]                                          # (BQ, D)
+    k = k_ref[0]                                          # (BK, D)
+    v = v_ref[0]
+    msk = mask_ref[0, 0] != 0                             # (BK,)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # (BQ, BK)
+        preferred_element_type=jnp.float32) * scale       # (BQ, BK) f32
     s = jnp.where(msk[None, :], s, _NEG)
     m_prev = m_scr[:]
     m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -76,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     m_scr[:] = m_new
     l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
     acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(j == pl.num_programs(2) - 1)
@@ -86,11 +90,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
         # Fully-masked rows: zero output, lse pinned to 0 so backward's
         # exp(_NEG - 0) underflows to 0 rather than NaN.
-        lse_ref[0] = jnp.where(
+        lse_ref[0, 0] = jnp.where(
             l[:, 0] > 0, m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
 
 
 def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
+    # Rank-1-per-tile operands (mask, lse) ride as (BH, 1, S) so every block
+    # shape is rank >= 2 with a compiled-lowering-legal tail: Mosaic requires
+    # the last two block dims be (multiples of, or equal to) the array dims —
+    # a (1, BK) block over a (BH, S) array is not (VERDICT r1 #6, found on
+    # first real-TPU run).
     bh, s, d = q.shape
     bq, bk = _block(s, block_q), _block(s, block_k)
     out, lse = pl.pallas_call(
@@ -100,24 +109,26 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask)
-    return out, lse
+    )(q, k, v, mask[:, None, :])
+    return out, lse.reshape(bh, s)
 
 
 # ---------------------------------------------------------------------------
@@ -133,13 +144,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    msk = mask_ref[0] != 0
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    k = k_ref[0]
+    v = v_ref[0]
+    msk = mask_ref[0, 0] != 0
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -149,7 +160,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = (p * (dp - delta) * scale).astype(k.dtype)
     dq_scr[:] += jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -168,13 +179,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    k = k_ref[0].astype(jnp.float32)                      # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    msk = mask_ref[0] != 0
-    q = q_ref[0].astype(jnp.float32)                      # (BQ, D)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    k = k_ref[0]                                          # (BK, D)
+    v = v_ref[0]
+    msk = mask_ref[0, 0] != 0
+    q = q_ref[0]                                          # (BQ, D)
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -182,12 +193,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     s = jnp.where(msk[None, :], s, _NEG)
     p = jnp.exp(s - lse)                                  # (BQ, BK)
     dv_scr[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale                         # (BQ, BK)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)       # (BQ, BK)
     dk_scr[:] += jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -202,12 +213,14 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
     q, k, v, mask, out, lse = residuals
     bh, s, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # (BH, 1, S) lift for the rank-1-per-tile operands — see _fwd.
+    mask3, lse3, delta3 = (x[:, None, :] for x in (mask, lse, delta))
 
     bq, bk = _block(s, block_q), _block(s, block_k)
     q_tile = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     k_tile = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    maskk = pl.BlockSpec((1, bk), lambda b, i, j: (b, j))
-    vec_q = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    maskk = pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))
+    vec_q = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale),
@@ -216,15 +229,17 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
         out_specs=[q_tile],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask, g, lse, delta)[0]
+    )(q, k, v, mask3, g, lse3, delta3)[0]
 
     # dk/dv: K tiles are the revisited outputs, Q is the accumulation axis
     # (innermost grid dim), so swap the roles of the last two grid indices.
     q_acc = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
     k_out = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    maskk2 = pl.BlockSpec((1, bk), lambda b, j, i: (b, j))
-    vec_q2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    maskk2 = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))
+    vec_q2 = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale),
         grid=(bh, s // bk, s // bq),
@@ -234,8 +249,10 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask, g, lse, delta)
+    )(q, k, v, mask3, g, lse3, delta3)
     return dq, dk, dv, None
 
 
@@ -255,8 +272,8 @@ def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
-def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 128,
-                    block_k: int = 128,
+def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 512,
+                    block_k: int = 1024,
                     interpret: Optional[bool] = None):
     """Fused non-causal attention with a key-padding mask.
 

@@ -30,15 +30,23 @@ MESH_AXES: tuple[str, ...] = (
 
 
 def make_mesh(parallel: ParallelConfig,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+              devices: Optional[Sequence[jax.Device]] = None,
+              backend: Optional[str] = None) -> Mesh:
     """Build a Mesh matching ``parallel``'s axis sizes.
+
+    ``backend="cpu"`` forces the mesh onto the host's CPU devices even when
+    an accelerator platform is active — the library-level counterpart of
+    ``train.py --backend=cpu`` (BASELINE.json:5), so
+    ``TrainConfig(backend="cpu")`` works from Python too. ``backend="tpu"``
+    (the default) uses the ambient platform's devices, matching the CLI's
+    env-var dispatch.
 
     Uses ``mesh_utils.create_device_mesh`` on real TPU platforms so the mesh
     axes align with the physical ICI torus; falls back to a reshape for CPU
     test devices (where topology is fake anyway).
     """
     if devices is None:
-        devices = jax.devices()
+        devices = jax.devices("cpu") if backend == "cpu" else jax.devices()
     sizes = parallel.axis_sizes()
     shape = tuple(sizes[a] for a in MESH_AXES)
     n = int(np.prod(shape))

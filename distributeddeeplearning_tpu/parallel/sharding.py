@@ -25,6 +25,9 @@ def logical_rules(parallel: ParallelConfig) -> tuple[tuple[str, Any], ...]:
     - ``seq`` → "seq": sequence/context parallelism over activations.
     - ``heads``/``mlp``/``vocab`` → "model": Megatron-style TP.
     - ``embed`` → "fsdp": parameter sharding when fsdp>1, else replicated.
+    - ``experts`` → "expert": MoE expert parallelism (models/moe.py) — the
+      dispatch/combine einsums become XLA all-to-alls over ICI.
+    - ``layers`` → "pipeline": stage-stacked layer params (parallel/pipeline.py).
     """
     rules = [
         ("batch", ("data", "fsdp")),
@@ -34,6 +37,8 @@ def logical_rules(parallel: ParallelConfig) -> tuple[tuple[str, Any], ...]:
         ("vocab", "model"),
         ("embed", "fsdp" if parallel.fsdp > 1 else None),
         ("embed_out", None),
+        ("experts", "expert"),
+        ("layers", "pipeline"),
     ]
     return tuple(rules)
 

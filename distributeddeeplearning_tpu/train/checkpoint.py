@@ -15,6 +15,7 @@ checkpoint-resume model the reference's mpirun jobs had, minus Batch-AI.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -75,6 +76,38 @@ class Checkpointer:
             return None
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(_abstract_like(state_like)))
+
+    def verify_or_record_stream_meta(self, meta: dict) -> None:
+        """Pin environment-dependent data-stream facts (e.g. the resolved
+        ``auto`` loader) to the checkpoint directory.
+
+        First run records ``meta``; a resumed run whose resolution differs
+        (say the C++ toolchain vanished and auto now picks tf.data, whose
+        shuffle order differs) fails loudly instead of silently feeding a
+        different sample stream than the one the checkpoint was trained on
+        (ADVICE r1 #1). Pass the loader explicitly to override.
+        """
+        path = os.path.join(self._mgr.directory, "stream_meta.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                recorded = json.load(f)
+            clashes = {k: (recorded[k], v) for k, v in meta.items()
+                       if k in recorded and recorded[k] != v}
+            if clashes:
+                raise RuntimeError(
+                    f"checkpoint stream metadata mismatch in {path}: "
+                    + "; ".join(
+                        f"{k}: recorded {old!r}, this run resolved {new!r}"
+                        for k, (old, new) in clashes.items())
+                    + ". Resuming with a different data pipeline would "
+                    "change the post-resume sample stream. Set the field "
+                    "explicitly (e.g. --loader) to match the original run, "
+                    "or start a fresh checkpoint_dir.")
+        elif jax.process_index() == 0:
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, path)
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -63,7 +63,7 @@ def build(config: TrainConfig, total_steps: int):
             "attention_impl='flash' is incompatible with seq-axis "
             "parallelism (it needs the full sequence per device); use "
             "attention_impl='ring' for seq>1")
-    mesh = meshlib.make_mesh(config.parallel)
+    mesh = meshlib.make_mesh(config.parallel, backend=config.backend)
     dtype = _dtype(config)
     if spec.input_kind == "tokens":
         kw: dict = dict(vocab_size=config.data.vocab_size, dtype=dtype,
@@ -167,6 +167,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             f"fail_at_step={config.fail_at_step} is beyond "
             f"total_steps={total_steps}; the injected fault would never fire")
     start_step = 0
+    resolved_loader = datalib.resolve_loader(config, spec.input_kind)
+    if ckpt is not None:
+        # Pin the environment-dependent loader resolution to the checkpoint:
+        # a resume that would silently switch pipelines (different shuffle
+        # order) fails loudly instead (ADVICE r1 #1).
+        ckpt.verify_or_record_stream_meta({"loader": resolved_loader})
     if ckpt is not None and config.resume:
         restored = ckpt.restore_latest(state)
         if restored is not None:
@@ -187,7 +193,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # stderr so harness consumers (bench.py) keep a clean stdout
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
-              f"dtype={config.dtype}"
+              f"dtype={config.dtype} loader={resolved_loader}"
               + (f" | resumed@{start_step}" if start_step else ""),
               file=sys.stderr, flush=True)
 
@@ -358,8 +364,14 @@ class _Evaluator:
         if self.synthetic:
             source, offset = self._synth_source, self.SYNTHETIC_EVAL_OFFSET
         else:
+            # Fresh finite stream per eval; prefetch_depth=0 so construction
+            # doesn't eagerly decode lookahead batches that a short
+            # (num_batches-bounded) eval would then throw away.
+            import dataclasses
+            cfg = self._config.replace(data=dataclasses.replace(
+                self._config.data, prefetch_depth=0))
             source, offset = datalib.make_source(
-                self._config, "image", self._batch_shd, train=False), 0
+                cfg, "image", self._batch_shd, train=False), 0
         correct = total = 0
         for j in range(self.num_batches):
             counts = self.eval_step(state, source.batch(offset + j))

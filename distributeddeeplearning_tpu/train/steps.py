@@ -65,15 +65,25 @@ def _image_loss_fn(model, config: TrainConfig):
 
 def _token_loss_fn(model, config: TrainConfig):
     del config
+    # MoE models sow per-layer load-balance losses into "moe_losses"
+    # (models/moe.py); weight comes from the model's own config so dense
+    # models pay nothing.
+    aux_weight = getattr(getattr(model, "cfg", None), "moe_aux_weight", 0.0)
 
     def loss_fn(params, batch_stats, batch, rng):
         del batch_stats
-        logits = model.apply(
+        logits, mutated = model.apply(
             {"params": params}, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
-            train=True, rngs={"dropout": rng})
+            train=True, rngs={"dropout": rng}, mutable=["moe_losses"])
         loss = losses.mlm_loss(logits, batch["labels"])
-        return loss, (None, {"loss": loss})
+        metrics = {"loss": loss}
+        aux_leaves = jax.tree_util.tree_leaves(mutated.get("moe_losses", {}))
+        if aux_leaves:
+            aux = sum(aux_leaves) / len(aux_leaves)
+            loss = loss + aux_weight * aux
+            metrics["moe_aux"] = aux
+        return loss, (None, metrics)
 
     return loss_fn
 

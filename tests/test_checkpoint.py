@@ -79,3 +79,18 @@ def test_eval_top1_aggregates_across_shards(quiet):
     summary = loop.run(tiny_cfg(parallel=ParallelConfig(data=4)),
                        total_steps=2, logger=quiet, eval_batches=2)
     assert 0.0 <= summary["eval_top1"] <= 1.0
+
+
+def test_stream_meta_mismatch_fails_loudly(tmp_path):
+    """A resume whose loader resolution changed must not silently feed a
+    different sample stream (ADVICE r1 #1)."""
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), every_steps=10)
+    try:
+        ckpt.verify_or_record_stream_meta({"loader": "native"})
+        ckpt.verify_or_record_stream_meta({"loader": "native"})  # same: ok
+        with pytest.raises(RuntimeError, match="native.*tf|tf.*native"):
+            ckpt.verify_or_record_stream_meta({"loader": "tf"})
+    finally:
+        ckpt.close()

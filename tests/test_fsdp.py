@@ -1,0 +1,72 @@
+"""fsdp axis: params must actually shard over ``fsdp`` (embed-dim ZeRO-3
+style) and training numerics must match the pure-DP run (VERDICT r1 #5 —
+"prove fsdp or drop it").
+
+The ``embed -> fsdp`` rule (parallel/sharding.py) shards every kernel's
+embedding dimension across the fsdp axis; XLA then all-gathers params where
+a full operand is needed and reduce-scatters gradients — the compiler-emitted
+equivalent of FSDP's explicit gather/scatter machinery.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearning_tpu.config import (
+    DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.data.synthetic import SyntheticTokens
+from distributeddeeplearning_tpu.models import bert
+from distributeddeeplearning_tpu.parallel.mesh import make_mesh
+from distributeddeeplearning_tpu.train import optim, steps
+
+VOCAB, SEQ, BATCH = 1024, 32, 8
+
+
+def build(parallel: ParallelConfig):
+    cfg = TrainConfig(
+        model="bert_tiny", global_batch_size=BATCH, dtype="float32",
+        parallel=parallel,
+        data=DataConfig(dataset="mlm", seq_len=SEQ, vocab_size=VOCAB),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3,
+                                  schedule="linear", label_smoothing=0.0))
+    mesh = make_mesh(cfg.parallel)
+    model = bert.tiny_bert_mlm(vocab_size=VOCAB)
+    tx, _ = optim.make_optimizer(cfg.optimizer, cfg.global_batch_size, 100)
+    src = SyntheticTokens(BATCH, SEQ, VOCAB, seed=7)
+    state, shardings = steps.init_sharded_state(
+        model, tx, mesh, cfg, src.batch(0), jax.random.key(0), "tokens")
+    step = steps.make_gspmd_train_step(model, tx, mesh, cfg, shardings,
+                                       "tokens")
+    return src, state, step
+
+
+def test_fsdp_params_actually_shard(devices8):
+    _, state, _ = build(ParallelConfig(data=2, fsdp=2, model=2))
+    qk = state.params["layer0"]["attention"]["query"]["kernel"].value
+    # ("embed", "heads") logical axes -> embed over fsdp, heads over model.
+    assert qk.sharding.spec == P("fsdp", "model"), qk.sharding
+    emb = state.params["word_embeddings"].value
+    # ("vocab", "embed") -> vocab-parallel over model, embed over fsdp.
+    assert emb.sharding.spec == P("model", "fsdp"), emb.sharding
+    mlp_out = state.params["layer0"]["mlp_output"]["kernel"].value
+    assert mlp_out.sharding.spec == P("model", "fsdp"), mlp_out.sharding
+    # The optimizer state mirrors the param layout (sharded moments).
+    mu_qk = state.opt_state[0].mu["layer0"]["attention"]["query"]["kernel"]
+    mu_qk = getattr(mu_qk, "value", mu_qk)
+    assert mu_qk.sharding.spec == P("fsdp", "model"), mu_qk.sharding
+
+
+def test_fsdp_matches_dp_numerics(devices8):
+    """3 training steps under fsdp=2 == pure dp=8, same seed/batches."""
+    losses = {}
+    for name, parallel in [("dp", ParallelConfig(data=8)),
+                           ("fsdp", ParallelConfig(data=4, fsdp=2))]:
+        src, state, step = build(parallel)
+        rng = jax.random.key(42)
+        out = []
+        for i in range(3):
+            state, metrics = step(state, src.batch(i), rng)
+            out.append(float(metrics["loss"]))
+        losses[name] = out
+    np.testing.assert_allclose(losses["dp"], losses["fsdp"],
+                               rtol=2e-4, atol=2e-5)

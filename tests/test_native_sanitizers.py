@@ -1,0 +1,48 @@
+"""Sanitizer builds of the native loader (SURVEY.md §5.2).
+
+The C++ stress driver (csrc/loader_test.cc) exercises the batch-slot ring's
+concurrency — worker pool vs. consumer, shutdown while blocked, finite-stream
+exhaustion, start_batch resume — with no Python in the process. Here we run
+it plain and under ThreadSanitizer; `make asan` is available for manual runs
+(ASan's interceptors make it the slowest of the three).
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+CSRC = Path(__file__).resolve().parent.parent / "csrc"
+
+
+def _make(target: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["make", target], cwd=CSRC, capture_output=True, text=True,
+        timeout=600)
+
+
+def _sanitizer_supported(flag: str) -> bool:
+    """Probe whether g++ can link the sanitizer runtime on this machine."""
+    probe = subprocess.run(
+        ["g++", "-x", "c++", "-", f"-fsanitize={flag}", "-o", "/dev/null"],
+        input="int main(){return 0;}", capture_output=True, text=True,
+        timeout=120)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_loader_stress_driver():
+    proc = _make("test")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ALL OK" in proc.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_loader_tsan():
+    if not _sanitizer_supported("thread"):
+        pytest.skip("tsan runtime not available")
+    proc = _make("tsan")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ALL OK" in proc.stdout
+    assert "WARNING: ThreadSanitizer" not in proc.stdout + proc.stderr

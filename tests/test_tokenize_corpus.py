@@ -1,0 +1,99 @@
+"""tools/tokenize_corpus.py: raw text -> packed shards -> config 4 runs
+end-to-end from a raw-text fixture (VERDICT r1 #8)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import tokenize_corpus as tc  # noqa: E402
+
+from distributeddeeplearning_tpu.config import (  # noqa: E402
+    DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+
+WORDS = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog",
+         "pack", "my", "box", "with", "five", "dozen", "liquor", "jugs"]
+SUBWORDS = ["##s", "##ing", "##ed"]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    """BERT-layout vocab: specials at canonical ids, real tokens >= 1000
+    (data/tokens.py treats ids <= 999 as never-masked specials)."""
+    rows = ["[PAD]"] + [f"[unused{i}]" for i in range(99)] + [
+        "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    rows += [f"[unused{i}]" for i in range(99, 99 + (1000 - len(rows)))]
+    assert len(rows) == 1000
+    rows += WORDS + SUBWORDS + [".", ","]
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(rows) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def corpus_dir(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    d = tmp_path_factory.mktemp("corpus")
+    for f in range(2):
+        lines = []
+        for _ in range(40):  # documents
+            for _ in range(rng.integers(2, 6)):  # sentences
+                n = rng.integers(4, 12)
+                lines.append(" ".join(rng.choice(WORDS, n)) + " .")
+            lines.append("")
+        (d / f"part{f}.txt").write_text("\n".join(lines))
+    return str(d)
+
+
+def test_wordpiece_matches_reference_algorithm(vocab_file):
+    wp = tc.WordPiece(tc.load_vocab(vocab_file))
+    # "jumps" is not in vocab whole, but "jump"+"##s" isn't either (no
+    # "jump") — whole word IS in vocab here. Exercise continuation on
+    # "foxes" -> fox + ##e? no "##e" -> [UNK]; "dogs" -> dog + ##s.
+    ids = wp.encode("The dogs jumps .")
+    v = tc.load_vocab(vocab_file)
+    assert ids == [v["the"], v["dog"], v["##s"], v["jumps"], v["."]]
+    assert wp.encode("zzz")[0] == v["[UNK]"]
+
+
+def test_shards_shape_and_layout(vocab_file, corpus_dir, tmp_path):
+    rc = tc.main(["--input", f"{corpus_dir}/*.txt", "--vocab", vocab_file,
+                  "--out-dir", str(tmp_path), "--seq-len", "64",
+                  "--shard-size", "128"])
+    assert rc == 0
+    shards = sorted(tmp_path.glob("train-*.npy"))
+    assert shards
+    arr = np.load(shards[0])
+    v = tc.load_vocab(vocab_file)
+    assert arr.dtype == np.int32 and arr.shape[1] == 64
+    assert (arr[:, 0] == v["[CLS]"]).all()
+    # Every row terminates with [SEP] then only padding.
+    for row in arr[:32]:
+        sep_pos = np.flatnonzero(row == v["[SEP]"])
+        assert len(sep_pos) == 1
+        assert (row[sep_pos[0] + 1:] == v["[PAD]"]).all()
+
+
+def test_config4_runs_from_raw_text(vocab_file, corpus_dir, tmp_path,
+                                    devices8):
+    """The full acceptance path: raw text -> shards -> MLM training on the
+    8-device mesh via the standard loop."""
+    from distributeddeeplearning_tpu.train import loop
+
+    rc = tc.main(["--input", f"{corpus_dir}/*.txt", "--vocab", vocab_file,
+                  "--out-dir", str(tmp_path), "--seq-len", "32"])
+    assert rc == 0
+    vocab_size = len(tc.load_vocab(vocab_file))
+    cfg = TrainConfig(
+        model="bert_tiny", global_batch_size=8, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(dataset="mlm", data_dir=str(tmp_path),
+                        synthetic=False, seq_len=32, vocab_size=vocab_size),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3,
+                                  schedule="linear", label_smoothing=0.0))
+    summary = loop.run(cfg, total_steps=4)
+    assert summary["final_step"] == 4
+    assert np.isfinite(summary["final_metrics"]["loss"])

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Raw text -> packed-token ``.npy`` shards for the MLM pipeline.
+
+Produces exactly what ``data/tokens.py`` consumes (config 4,
+BASELINE.json:10): ``<split>-NNNNN.npy`` files of int32 ids with shape
+``(N, seq_len)``, BERT-style packed — ``[CLS] sent sent ... [SEP]`` greedily
+filled per document, padded with ``[PAD]``.
+
+Runs fully offline from a WordPiece ``vocab.txt`` (one token per line, the
+standard BERT layout: [PAD]=0, [UNK]=100, [CLS]=101, [SEP]=102, [MASK]=103);
+the in-tree WordPiece implementation is greedy longest-match-first with
+``##`` continuations — byte-compatible with the canonical algorithm, no
+tokenizer download needed.
+
+Usage:
+  python tools/tokenize_corpus.py --input corpus/*.txt --vocab vocab.txt \
+      --out-dir /data/mlm --seq-len 128 [--split train] [--shard-size 65536]
+
+Input format: plain text; blank lines separate documents (wiki-dump style).
+Each line within a document is treated as one sentence for packing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import unicodedata
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def load_vocab(path: str) -> dict[str, int]:
+    vocab: dict[str, int] = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    for required in ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"):
+        if required not in vocab:
+            raise ValueError(f"vocab {path!r} is missing {required}")
+    return vocab
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or \
+            (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def basic_tokenize(text: str, *, lowercase: bool = True) -> list[str]:
+    """Whitespace + punctuation split (BERT's BasicTokenizer, sans CJK
+    special-casing)."""
+    if lowercase:
+        text = text.lower()
+        text = "".join(c for c in unicodedata.normalize("NFD", text)
+                       if unicodedata.category(c) != "Mn")
+    out: list[str] = []
+    word = []
+    for ch in text:
+        if ch.isspace():
+            if word:
+                out.append("".join(word))
+                word = []
+        elif _is_punct(ch):
+            if word:
+                out.append("".join(word))
+                word = []
+            out.append(ch)
+        else:
+            word.append(ch)
+    if word:
+        out.append("".join(word))
+    return out
+
+
+class WordPiece:
+    """Greedy longest-match-first WordPiece over a loaded vocab."""
+
+    def __init__(self, vocab: dict[str, int], *, lowercase: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.lowercase = lowercase
+        self.unk = vocab["[UNK]"]
+        self.max_chars = max_chars_per_word
+
+    def encode_words(self, words: list[str]) -> list[int]:
+        ids: list[int] = []
+        for word in words:
+            if len(word) > self.max_chars:
+                ids.append(self.unk)
+                continue
+            start, pieces, bad = 0, [], False
+            while start < len(word):
+                end = len(word)
+                cur: Optional[int] = None
+                while start < end:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    if sub in self.vocab:
+                        cur = self.vocab[sub]
+                        break
+                    end -= 1
+                if cur is None:
+                    bad = True
+                    break
+                pieces.append(cur)
+                start = end
+            ids.extend([self.unk] if bad else pieces)
+        return ids
+
+    def encode(self, text: str) -> list[int]:
+        return self.encode_words(
+            basic_tokenize(text, lowercase=self.lowercase))
+
+
+def documents(paths: list[str]) -> Iterator[list[str]]:
+    """Yield documents (lists of non-empty lines); blank line = boundary."""
+    for path in paths:
+        doc: list[str] = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if doc:
+                        yield doc
+                        doc = []
+                else:
+                    doc.append(line)
+        if doc:
+            yield doc
+
+
+def pack_documents(docs: Iterator[list[str]], wp: WordPiece,
+                   seq_len: int) -> Iterator[np.ndarray]:
+    """BERT packing: [CLS] sentences... [SEP], greedy fill, pad to seq_len.
+
+    Sequences never cross document boundaries; a sentence longer than the
+    budget is hard-truncated (canonical BERT prep behavior).
+    """
+    pad = wp.vocab["[PAD]"]
+    cls_, sep = wp.vocab["[CLS]"], wp.vocab["[SEP]"]
+    budget = seq_len - 2  # room for [CLS] ... [SEP]
+    for doc in docs:
+        cur: list[int] = []
+        for sentence in doc:
+            ids = wp.encode(sentence)
+            while ids:
+                space = budget - len(cur)
+                take, ids = ids[:space], ids[space:]
+                cur.extend(take)
+                if len(cur) >= budget:
+                    yield np.asarray(
+                        [cls_] + cur + [sep], np.int32)
+                    cur = []
+        if cur:
+            row = [cls_] + cur + [sep]
+            yield np.asarray(row + [pad] * (seq_len - len(row)), np.int32)
+
+
+def write_shards(rows: Iterator[np.ndarray], out_dir: str, split: str,
+                 seq_len: int, shard_size: int) -> list[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    written: list[str] = []
+    buf: list[np.ndarray] = []
+
+    def flush():
+        if not buf:
+            return
+        path = os.path.join(out_dir, f"{split}-{len(written):05d}.npy")
+        np.save(path, np.stack(buf))
+        written.append(path)
+        buf.clear()
+
+    for row in rows:
+        assert row.shape == (seq_len,)
+        buf.append(row)
+        if len(buf) >= shard_size:
+            flush()
+    flush()
+    return written
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--input", nargs="+", required=True,
+                   help="raw-text files or globs (blank line = doc boundary)")
+    p.add_argument("--vocab", required=True, help="WordPiece vocab.txt")
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--split", default="train",
+                   help="output prefix: train | validation")
+    p.add_argument("--shard-size", type=int, default=65536,
+                   help="sequences per .npy shard")
+    p.add_argument("--cased", action="store_true",
+                   help="disable lowercasing/accent-stripping")
+    args = p.parse_args(argv)
+
+    paths = sorted(sum((glob.glob(g) for g in args.input), []))
+    if not paths:
+        print(f"no input files match {args.input}", file=sys.stderr)
+        return 1
+    wp = WordPiece(load_vocab(args.vocab), lowercase=not args.cased)
+    rows = pack_documents(documents(paths), wp, args.seq_len)
+    written = write_shards(rows, args.out_dir, args.split, args.seq_len,
+                           args.shard_size)
+    total = sum(int(np.load(p, mmap_mode="r").shape[0]) for p in written)
+    print(f"wrote {total} sequences of seq_len={args.seq_len} across "
+          f"{len(written)} shard(s) to {args.out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

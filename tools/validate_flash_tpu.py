@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""On-hardware validation of the Pallas flash-attention kernel (VERDICT r1
+#6): run the COMPILED forward+backward on the TPU at BERT-base shapes and
+compare against the dense attention reference, then time both.
+
+Prints one JSON line per check; exits nonzero on any correctness failure.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+# Runnable from anywhere without touching PYTHONPATH (which carries the
+# platform plugin on axon machines).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_ref(q, k, v, mask):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (d ** -0.5)
+    s = jnp.where(mask[:, None, None, :], s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _sync(out):
+    # device_get is a true execution barrier; block_until_ready on a
+    # remote-tunneled device can return while programs are still in flight
+    # (same caveat as train/loop.py's timing window).
+    jax.device_get(jax.tree_util.tree_map(lambda x: x.ravel()[0], out))
+
+
+def timed(fn, *args, iters=20):
+    _sync(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    from distributeddeeplearning_tpu.ops.flash_attention import flash_attention
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(json.dumps({"error": f"need TPU, got {backend}"}))
+        return 1
+
+    B, S, H, D = 8, 512, 12, 64  # BERT-base attention shapes
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
+    # Padding mask with ragged valid lengths, incl. one fully-valid row.
+    lens = np.r_[S, rng.integers(S // 4, S, B - 1)]
+    mask = jnp.asarray(np.arange(S)[None, :] < lens[:, None])
+
+    flash = jax.jit(functools.partial(flash_attention, interpret=False))
+    dense = jax.jit(dense_ref)
+
+    out_f = np.asarray(flash(q, k, v, mask), np.float32)
+    out_d = np.asarray(dense(q, k, v, mask), np.float32)
+    valid = np.asarray(mask)[:, :, None, None]
+    fwd_err = float(np.abs((out_f - out_d) * valid).max())
+    ok_fwd = fwd_err < 2e-2  # bf16 inputs, f32 accumulation
+    print(json.dumps({"check": "forward", "max_abs_err": fwd_err,
+                      "ok": ok_fwd}), flush=True)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, mask, interpret=False)
+        return (o.astype(jnp.float32) * valid ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_ref(q, k, v, mask).astype(jnp.float32) * valid ** 2).sum()
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    gerrs = {}
+    ok_bwd = True
+    for name, a, b in zip("dq dk dv".split(), gf, gd):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(np.abs(b).max(), 1.0)
+        err = float(np.abs(a - b).max() / scale)
+        gerrs[name] = err
+        ok_bwd &= err < 3e-2
+    print(json.dumps({"check": "backward", "rel_err": gerrs, "ok": ok_bwd}),
+          flush=True)
+
+    t_flash = timed(flash, q, k, v, mask)
+    t_dense = timed(dense, q, k, v, mask)
+    grad_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    grad_d = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
+    t_flash_bwd = timed(grad_f, q, k, v)
+    t_dense_bwd = timed(grad_d, q, k, v)
+    print(json.dumps({
+        "check": "timing", "shape": [B, S, H, D],
+        "fwd_ms": {"flash": round(t_flash * 1e3, 3),
+                   "dense": round(t_dense * 1e3, 3)},
+        "fwd_bwd_ms": {"flash": round(t_flash_bwd * 1e3, 3),
+                       "dense": round(t_dense_bwd * 1e3, 3)},
+    }), flush=True)
+    return 0 if (ok_fwd and ok_bwd) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,22 @@ V100_AMP_RESNET50_IMAGES_PER_SEC = 1450.0
 RETRY_BACKOFF_SEC = (10, 30)  # sleeps between the 3 attempts
 
 
+def _metric_name_unit(args) -> tuple[str, str]:
+    """One source of truth for the metric identity, shared by the success
+    and error paths (parent + child processes). Consults the model registry
+    for the input kind; registry import touches no device backend."""
+    try:
+        from distributeddeeplearning_tpu.models import model_spec
+        tokens = model_spec(args.model).input_kind == "tokens"
+    except Exception:
+        tokens = "bert" in args.model  # registry unavailable: best effort
+    if tokens:
+        return (f"{args.model}_mlm_s{args.seq_len}_seqs_per_sec_per_chip",
+                "sequences/sec/chip")
+    return (f"{args.model}_imagenet_images_per_sec_per_chip",
+            "images/sec/chip")
+
+
 def _child(args) -> int:
     """Run the actual measurement; prints the one JSON metric line."""
     import jax
@@ -44,17 +60,23 @@ def _child(args) -> int:
 
     from distributeddeeplearning_tpu.config import (
         DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.models import model_spec
     from distributeddeeplearning_tpu.train import loop
     from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
     n_dev = jax.device_count()
+    tokens = model_spec(args.model).input_kind == "tokens"
+    data = (DataConfig(synthetic=True, dataset="mlm", seq_len=args.seq_len)
+            if tokens else DataConfig(synthetic=True))
     cfg = TrainConfig(
         model=args.model,
         global_batch_size=args.batch_size * n_dev,
         dtype="bfloat16",
         log_every=10**9,  # silent; bench prints exactly one line
+        attention_impl=args.attention_impl,
+        remat=args.remat,
         parallel=ParallelConfig(data=n_dev),
-        data=DataConfig(synthetic=True))
+        data=data)
 
     summary = loop.run(
         cfg, total_steps=args.warmup_steps + args.steps,
@@ -62,20 +84,25 @@ def _child(args) -> int:
         logger=MetricLogger(enabled=False))
 
     value = summary["examples_per_sec_per_chip"]
+    metric, unit = _metric_name_unit(args)
+    # Token models have no published reference -> vs_baseline omitted;
+    # images compare against the per-chip V100 target.
     print(json.dumps({
-        "metric": f"{args.model}_imagenet_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(value, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(value / V100_AMP_RESNET50_IMAGES_PER_SEC, 4),
+        "unit": unit,
+        "vs_baseline": (None if tokens else
+                        round(value / V100_AMP_RESNET50_IMAGES_PER_SEC, 4)),
     }), flush=True)
     return 0
 
 
 def _emit_error(args, msg: str) -> None:
+    metric, unit = _metric_name_unit(args)
     print(json.dumps({
-        "metric": f"{args.model}_imagenet_images_per_sec_per_chip",
+        "metric": metric,
         "value": None,
-        "unit": "images/sec/chip",
+        "unit": unit,
         "vs_baseline": None,
         "error": msg[-800:],
     }), flush=True)
@@ -89,6 +116,13 @@ def main(argv=None) -> int:
     # enough to amortize per-step dispatch latency, small enough to stay
     # HBM-friendly.
     p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--seq-len", type=int, default=512,
+                   help="sequence length for token (BERT) models")
+    p.add_argument("--attention-impl", default=None,
+                   choices=[None, "dense", "flash", "ring"],
+                   help="attention implementation for token models")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize transformer layers in backward")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup-steps", type=int, default=10)
     p.add_argument("--platform", default=None,
@@ -109,10 +143,15 @@ def main(argv=None) -> int:
     child_cmd = [sys.executable, os.path.abspath(__file__), "--run-child",
                  "--model", args.model,
                  "--batch-size", str(args.batch_size),
+                 "--seq-len", str(args.seq_len),
                  "--steps", str(args.steps),
                  "--warmup-steps", str(args.warmup_steps)]
     if args.platform:
         child_cmd += ["--platform", args.platform]
+    if args.attention_impl:
+        child_cmd += ["--attention-impl", args.attention_impl]
+    if args.remat:
+        child_cmd += ["--remat"]
 
     last_err = "no attempt ran"
     deadline = time.monotonic() + args.budget
@@ -132,11 +171,14 @@ def main(argv=None) -> int:
         except subprocess.TimeoutExpired as e:
             # The child may have printed its metric line and then hung in
             # backend teardown (the classic remote-TPU failure mode) — scan
-            # the captured-so-far stdout before declaring the attempt dead.
-            stdout = e.stdout or b""
-            if isinstance(stdout, bytes):
-                stdout = stdout.decode(errors="replace")
-            stderr, rc = "", f"timeout {min(args.attempt_timeout, int(remaining))}s"
+            # the captured-so-far stdout before declaring the attempt dead;
+            # keep stderr too so the hung child's traceback reaches the
+            # error record.
+            def _text(buf):
+                return (buf.decode(errors="replace")
+                        if isinstance(buf, bytes) else buf or "")
+            stdout, stderr = _text(e.stdout), _text(e.stderr)
+            rc = f"timeout {min(args.attempt_timeout, int(remaining))}s"
         # Find the metric line: last stdout line that parses as JSON.
         for line in reversed(stdout.splitlines()):
             line = line.strip()

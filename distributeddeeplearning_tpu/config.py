@@ -26,8 +26,8 @@ class ParallelConfig:
     fsdp: int = 1       # parameter sharding along the data axis family
     model: int = 1      # tp: weight-column/row sharding
     seq: int = 1        # sp/cp: sequence-dim sharding (ring attention)
-    expert: int = 1     # ep: MoE expert sharding (reserved)
-    pipeline: int = 1   # pp: pipeline stages (reserved)
+    expert: int = 1     # ep: MoE expert sharding (models/moe.py)
+    pipeline: int = 1   # pp: GPipe pipeline stages (models/pipeline.py)
 
     @property
     def num_devices(self) -> int:
@@ -69,7 +69,7 @@ class DataConfig:
 class OptimizerConfig:
     """Optimizer + schedule (SGD-momentum default; LARS for config 5)."""
 
-    name: str = "sgd"             # sgd | lars | adamw
+    name: str = "sgd"             # sgd | lars | adamw | lamb
     learning_rate: float = 0.1    # for the reference batch size (256)
     reference_batch: int = 256    # linear-scaling rule base
     momentum: float = 0.9
@@ -111,6 +111,8 @@ class TrainConfig:
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
     fail_at_step: Optional[int] = None  # fault injection (SURVEY.md §5.3)
     attention_impl: Optional[str] = None  # None=default; dense|ring|flash
+    remat: bool = False           # recompute transformer-layer activations
+                                  # in backward (less HBM, ~1/3 more FLOPs)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

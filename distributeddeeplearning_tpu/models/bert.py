@@ -52,6 +52,10 @@ class BertConfig:
     # layers (the stages must be homogeneous).
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    # Rematerialization: recompute each encoder layer's activations in the
+    # backward pass instead of storing them — trades ~1/3 more FLOPs for
+    # O(num_layers) less activation HBM (the long-context/deep-model knob).
+    remat: bool = False
 
 
 def _dense(features, logical_axes, name, dtype, use_bias=True):
@@ -215,6 +219,7 @@ class BertMLM(nn.Module):
                 num_stages=cfg.pipeline_stages,
                 layers_per_stage=cfg.num_layers // cfg.pipeline_stages,
                 num_microbatches=cfg.pipeline_microbatches,
+                remat=cfg.remat,
                 dtype=self.dtype, name="pipeline")(
                     x, attention_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
@@ -222,9 +227,19 @@ class BertMLM(nn.Module):
             for i in range(cfg.num_layers):
                 use_moe = (cfg.num_experts > 0
                            and i % cfg.moe_every == cfg.moe_every - 1)
-                x = EncoderLayer(cfg, self.dtype, use_moe=use_moe,
-                                 name=f"layer{i}")(
-                    x, attention_mask, deterministic=deterministic)
+                layer = EncoderLayer(cfg, self.dtype, use_moe=use_moe,
+                                     name=f"layer{i}")
+                if cfg.remat:
+                    # Function-lift form: `deterministic` stays a closed-over
+                    # Python bool (a traced bool would concretize inside
+                    # Dropout), x/mask are the remat-checkpointed inputs.
+                    x = nn.remat(
+                        lambda mdl, h, msk: mdl(
+                            h, msk, deterministic=deterministic))(
+                        layer, x, attention_mask)
+                else:
+                    x = layer(x, attention_mask,
+                              deterministic=deterministic)
                 x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         # MLM head: transform -> LayerNorm -> tied decoder + bias.

@@ -37,12 +37,19 @@ class _LayerStep(nn.Module):
 
     layer_factory: Callable[..., nn.Module]
     deterministic: bool
+    remat: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         x, mask = carry
-        x = self.layer_factory(name="layer")(
-            x, mask, deterministic=self.deterministic)
+        layer = self.layer_factory(name="layer")
+        if self.remat:
+            det = self.deterministic
+            x = nn.remat(
+                lambda mdl, h, msk: mdl(h, msk, deterministic=det))(
+                layer, x, mask)
+        else:
+            x = layer(x, mask, deterministic=self.deterministic)
         return (x, mask), None
 
 
@@ -57,6 +64,7 @@ class PipelinedEncoder(nn.Module):
     num_stages: int
     layers_per_stage: int
     num_microbatches: int
+    remat: bool = False
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
@@ -83,7 +91,8 @@ class PipelinedEncoder(nn.Module):
             split_rngs={"params": True, "dropout": True},
             in_axes=((0, 0), None), out_axes=((0, 0), None),
             metadata_params={nn.PARTITION_NAME: "layers"})
-        stages = stages_cls(self.layer_factory, deterministic, name="stages")
+        stages = stages_cls(self.layer_factory, deterministic,
+                            remat=self.remat, name="stages")
 
         micro = x.reshape(m, mb, s, h)
         micro_mask = mask.reshape(m, mb, s)

@@ -56,10 +56,51 @@ def make_mesh(parallel: ParallelConfig,
             f"have {len(devices)}")
     devices = list(devices)[:n]  # sub-mesh on the first n devices
     if devices[0].platform == "tpu":
-        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+        if num_slices > 1:
+            # Multi-slice pod: slices are joined by DCN (the InfiniBand role —
+            # SURVEY.md §5.8), so the gradient-allreduce axes must span
+            # slices while tensor/sequence collectives stay on intra-slice
+            # ICI. create_hybrid_device_mesh lays devices out exactly so.
+            per_slice, dcn = _hybrid_shapes(shape, num_slices)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=list(devices))
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=list(devices))
     else:
         dev_array = np.asarray(list(devices)).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
+
+
+def _hybrid_shapes(shape: tuple[int, ...],
+                   num_slices: int) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split a global mesh shape into (per-slice ICI shape, DCN shape).
+
+    DCN (slow, inter-slice) carries the outermost axes in MESH_AXES order —
+    ``pipeline`` first, then ``data`` — because pipeline stage boundaries and
+    gradient allreduces tolerate DCN latency, while ``model``/``seq``
+    collectives are per-layer and must stay on ICI. Each consumed axis size
+    must be divisible by its DCN share.
+    """
+    per_slice, dcn = list(shape), [1] * len(shape)
+    remaining = num_slices
+    for i, axis in enumerate(MESH_AXES):
+        if remaining == 1:
+            break
+        if axis not in ("pipeline", "data"):
+            continue
+        take = np.gcd(per_slice[i], remaining)
+        if take > 1:
+            dcn[i] = int(take)
+            per_slice[i] //= int(take)
+            remaining //= int(take)
+    if remaining != 1:
+        raise ValueError(
+            f"cannot distribute {num_slices} slices over the "
+            f"pipeline/data axes of mesh {dict(zip(MESH_AXES, shape))}; "
+            f"make pipeline*data divisible by the slice count")
+    return tuple(per_slice), tuple(dcn)
 
 
 def data_axis_names(parallel: ParallelConfig) -> tuple[str, ...]:

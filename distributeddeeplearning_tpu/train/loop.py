@@ -70,9 +70,31 @@ def build(config: TrainConfig, total_steps: int):
                         seq_len=config.data.seq_len)
         if config.attention_impl:
             kw["attention_impl"] = config.attention_impl
+        if config.remat:
+            kw["remat"] = True
         model = spec.build(**kw)
     else:
         model = spec.build(num_classes=config.data.num_classes, dtype=dtype)
+
+    # A mesh axis nothing maps onto silently duplicates compute across its
+    # groups (devices wasted, no error from XLA) — reject up front, like the
+    # flash/seq check above.
+    mcfg = getattr(model, "cfg", None)
+    stages = getattr(mcfg, "pipeline_stages", 1)
+    experts = getattr(mcfg, "num_experts", 0)
+    if config.parallel.pipeline > 1 and stages % config.parallel.pipeline:
+        raise ValueError(
+            f"parallel.pipeline={config.parallel.pipeline} but model "
+            f"{config.model!r} has pipeline_stages={stages}; use a pipelined "
+            f"model (e.g. bert_base_pp) whose stage count is divisible by "
+            f"the mesh axis")
+    if config.parallel.expert > 1 and (experts == 0
+                                       or experts % config.parallel.expert):
+        raise ValueError(
+            f"parallel.expert={config.parallel.expert} but model "
+            f"{config.model!r} has num_experts={experts}; use an MoE model "
+            f"(e.g. bert_base_moe) whose expert count is divisible by the "
+            f"mesh axis")
 
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,

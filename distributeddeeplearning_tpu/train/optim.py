@@ -1,9 +1,10 @@
 """Optimizers + LR schedules (optax).
 
 Covers the acceptance matrix: SGD-momentum for the ResNet/DenseNet DP configs
-(BASELINE.json:7-9), AdamW for BERT MLM (BASELINE.json:10), and LARS with the
+(BASELINE.json:7-9), AdamW for BERT MLM (BASELINE.json:10), LARS with the
 linear-scaling + warmup + polynomial-decay recipe for batch=32k
-(BASELINE.json:11; recipe per PAPERS.md:8-9 large-batch papers).
+(BASELINE.json:11; recipe per PAPERS.md:8-9 large-batch papers), and LAMB
+for large-batch BERT.
 
 Weight decay is masked off BatchNorm/LayerNorm parameters and biases — the
 standard large-batch convention; for LARS the same mask also disables the
@@ -82,6 +83,12 @@ def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
             momentum=cfg.momentum)
     elif cfg.name == "adamw":
         tx = optax.adamw(
+            sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay, mask=_decay_mask)
+    elif cfg.name == "lamb":
+        # Layer-wise Adam (You et al.) — the canonical large-batch BERT
+        # optimizer, completing the pod-scale pair with LARS (CNNs).
+        tx = optax.lamb(
             sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
             weight_decay=cfg.weight_decay, mask=_decay_mask)
     else:

@@ -87,3 +87,17 @@ def test_sharded_forward_matches_unsharded(devices8):
             lambda v, x: model.apply(v, x, train=False))(variables, ids)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(sharded),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_hybrid_shapes_split():
+    """Multi-slice DCN factoring: outer (pipeline/data) axes absorb slices."""
+    from distributeddeeplearning_tpu.parallel.mesh import _hybrid_shapes
+
+    # MESH_AXES = (pipeline, data, fsdp, expert, seq, model)
+    per, dcn = _hybrid_shapes((1, 8, 1, 1, 2, 2), 2)
+    assert dcn == (1, 2, 1, 1, 1, 1) and per == (1, 4, 1, 1, 2, 2)
+    per, dcn = _hybrid_shapes((2, 8, 1, 1, 1, 4), 4)
+    assert dcn == (2, 2, 1, 1, 1, 1) and per == (1, 4, 1, 1, 1, 4)
+    import pytest
+    with pytest.raises(ValueError, match="slices"):
+        _hybrid_shapes((1, 3, 1, 1, 1, 4), 2)  # data=3 not divisible

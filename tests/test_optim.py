@@ -84,3 +84,28 @@ def test_sgd_momentum_step():
     # second step: momentum buffer = 1*0.9 + 1 = 1.9 -> update = -0.19
     np.testing.assert_allclose(np.asarray(updates["dense"]["kernel"]), -0.19,
                                rtol=1e-6)
+
+
+def test_lamb_optimizer_steps():
+    """LAMB builds and reduces loss on a toy quadratic."""
+    import jax
+    import jax.numpy as jnp
+    from distributeddeeplearning_tpu.config import OptimizerConfig
+    from distributeddeeplearning_tpu.train import optim
+
+    cfg = OptimizerConfig(name="lamb", learning_rate=0.1, reference_batch=1,
+                          schedule="constant", weight_decay=0.01)
+    tx, _ = optim.make_optimizer(cfg, global_batch=1, total_steps=10)
+    params = {"layer": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))}}
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        return (p["layer"]["kernel"] ** 2).sum() + (p["layer"]["bias"] ** 2).sum()
+
+    first = float(loss_fn(params))
+    for _ in range(5):
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+    assert float(loss_fn(params)) < first

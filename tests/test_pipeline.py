@@ -96,3 +96,19 @@ def test_pp_step_trains(devices8):
         last = float(metrics["loss"])
     assert np.isfinite(first) and np.isfinite(last)
     assert last < first, (first, last)
+
+
+def test_unconsumed_axis_rejected(devices8):
+    """A pipeline/expert mesh axis no model dim maps onto must error, not
+    silently duplicate compute across its groups."""
+    import pytest
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = _pp_cfg().replace(model="bert_tiny")  # not pipelined
+    with pytest.raises(ValueError, match="pipeline_stages"):
+        loop.build(cfg, total_steps=1)
+    moe_less = _pp_cfg().replace(
+        model="bert_tiny",
+        parallel=ParallelConfig(data=4, expert=2))
+    with pytest.raises(ValueError, match="num_experts"):
+        loop.build(moe_less, total_steps=1)

@@ -44,12 +44,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fsdp", type=int, default=None)
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
+    p.add_argument("--ep", type=int, default=None,
+                   help="expert-parallel size (MoE models)")
+    p.add_argument("--pp", type=int, default=None,
+                   help="pipeline-parallel size (pipelined models)")
     p.add_argument("--attn", default=None,
                    choices=["dense", "ring", "flash"],
                    help="attention impl for transformer models")
+    p.add_argument("--remat", action="store_true", default=None,
+                   help="rematerialize transformer layers in backward "
+                        "(less activation HBM, ~1/3 more FLOPs)")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")
-    p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw"])
+    p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw", "lamb"])
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     p.add_argument("--seed", type=int, default=None)
@@ -133,11 +140,17 @@ def build_config(args: argparse.Namespace):
         updates["model"] = args.tp
     if args.sp is not None:
         updates["seq"] = args.sp
+    if args.ep is not None:
+        updates["expert"] = args.ep
+    if args.pp is not None:
+        updates["pipeline"] = args.pp
     if updates:
         cfg = cfg.replace(parallel=dataclasses.replace(par, **updates))
 
     if args.attn:
         cfg = cfg.replace(attention_impl=args.attn)
+    if args.remat:
+        cfg = cfg.replace(remat=True)
 
     data_updates = {}
     if args.synthetic is not None:

@@ -38,14 +38,18 @@ def _metric_name_unit(args) -> tuple[str, str]:
     """One source of truth for the metric identity, shared by the success
     and error paths (parent + child processes). Consults the model registry
     for the input kind; registry import touches no device backend."""
+    objective = None
     try:
         from distributeddeeplearning_tpu.models import model_spec
-        tokens = model_spec(args.model).input_kind == "tokens"
+        spec = model_spec(args.model)
+        if spec.input_kind == "tokens":
+            objective = spec.objective
     except Exception:
-        tokens = "bert" in args.model  # registry unavailable: best effort
-    if tokens:
-        return (f"{args.model}_mlm_s{args.seq_len}_seqs_per_sec_per_chip",
-                "sequences/sec/chip")
+        if "bert" in args.model or "gpt" in args.model:  # best effort
+            objective = "causal" if "gpt" in args.model else "mlm"
+    if objective:
+        return (f"{args.model}_{objective}_s{args.seq_len}"
+                f"_seqs_per_sec_per_chip", "sequences/sec/chip")
     return (f"{args.model}_imagenet_images_per_sec_per_chip",
             "images/sec/chip")
 

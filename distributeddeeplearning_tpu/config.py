@@ -52,6 +52,8 @@ class DataConfig:
     dataset: str = "imagenet"
     data_dir: Optional[str] = None
     synthetic: bool = True        # config 1: "synthetic data" BASELINE.json:7
+    synthetic_learnable: bool = False  # embed a class signal in synthetic
+                                  # images (top-1 becomes meaningful)
     loader: str = "auto"          # auto | tf | native (csrc/ C++ loader)
     image_size: int = 224
     num_classes: int = 1000

@@ -47,7 +47,8 @@ def resolve_loader(config: TrainConfig, input_kind: str) -> str:
 
 def make_source(config: TrainConfig, input_kind: str,
                 sharding: Optional[jax.sharding.Sharding] = None, *,
-                start_step: int = 0, train: bool = True):
+                start_step: int = 0, train: bool = True,
+                objective: str = "classify"):
     """Route to the right pipeline for ``config.data``.
 
     - synthetic (or no data_dir): on-device deterministic batches, indexable
@@ -58,11 +59,13 @@ def make_source(config: TrainConfig, input_kind: str,
     """
     loader = resolve_loader(config, input_kind)
     if loader == "synthetic":
-        return synthetic.make_source(config, input_kind, sharding=sharding)
+        return synthetic.make_source(config, input_kind, sharding=sharding,
+                                     objective=objective)
     if loader == "tokens":
         from distributeddeeplearning_tpu.data import tokens
         return tokens.make_token_source(
-            config, sharding, start_step=start_step, train=train)
+            config, sharding, start_step=start_step, train=train,
+            objective=objective)
     if loader == "native":
         from distributeddeeplearning_tpu.data import native
         return native.make_native_source(

@@ -44,17 +44,26 @@ class _SyntheticSource:
 
 
 class SyntheticImages(_SyntheticSource):
-    """Fake ImageNet batches, generated in HBM."""
+    """Fake ImageNet batches, generated in HBM.
+
+    ``learnable=True`` embeds a fixed class-conditioned pattern under the
+    noise, so the label is recoverable from the image: training drives
+    top-1 toward 1.0 and the whole train→periodic-eval→best_top1 path can
+    be demonstrated end-to-end without a dataset (pure-noise mode keeps
+    benchmarking honest — no signal, stable step cost).
+    """
 
     def __init__(self, batch_size: int, image_size: int = 224,
                  num_classes: int = 1000, seed: int = 0,
-                 sharding: Optional[jax.sharding.Sharding] = None):
+                 sharding: Optional[jax.sharding.Sharding] = None,
+                 learnable: bool = False):
         self.batch_size = batch_size
         self.image_size = image_size
         self.num_classes = num_classes
         super().__init__(
             functools.partial(_gen_image_batch, batch=batch_size,
-                              size=image_size, num_classes=num_classes),
+                              size=image_size, num_classes=num_classes,
+                              learnable=learnable),
             seed, sharding)
 
 
@@ -76,12 +85,43 @@ class SyntheticTokens(_SyntheticSource):
             seed, sharding)
 
 
-def _gen_image_batch(key, step, *, batch, size, num_classes):
-    key = jax.random.fold_in(key, step)
-    k1, k2 = jax.random.split(key)
+def _gen_image_batch(key, step, *, batch, size, num_classes,
+                     learnable=False):
+    stepped = jax.random.fold_in(key, step)
+    k1, k2 = jax.random.split(stepped)
     image = jax.random.normal(k1, (batch, size, size, 3), jnp.bfloat16)
     label = jax.random.randint(k2, (batch,), 0, num_classes, jnp.int32)
+    if learnable:
+        # Per-class pattern keyed on (base seed, label) — constant across
+        # steps, so eval batches carry the same class signal training saw.
+        def pattern(lbl):
+            pk = jax.random.fold_in(jax.random.fold_in(key, 0x5157), lbl)
+            return jax.random.normal(pk, (size, size, 3), jnp.bfloat16)
+
+        image = 0.7 * image + jax.vmap(pattern)(label)
     return {"image": image, "label": label}
+
+
+class SyntheticCausalTokens(_SyntheticSource):
+    """Plain id sequences for causal-LM training (no masking)."""
+
+    def __init__(self, batch_size: int, seq_len: int = 128,
+                 vocab_size: int = 50257, seed: int = 0,
+                 sharding: Optional[jax.sharding.Sharding] = None):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        super().__init__(
+            functools.partial(_gen_causal_batch, batch=batch_size,
+                              seq_len=seq_len, vocab=vocab_size),
+            seed, sharding)
+
+
+def _gen_causal_batch(key, step, *, batch, seq_len, vocab):
+    key = jax.random.fold_in(key, step)
+    ids = jax.random.randint(key, (batch, seq_len), 1, vocab, jnp.int32)
+    return {"input_ids": ids,
+            "attention_mask": jnp.ones((batch, seq_len), jnp.int32)}
 
 
 def _gen_token_batch(key, step, *, batch, seq_len, vocab, mask_prob):
@@ -99,14 +139,19 @@ def _gen_token_batch(key, step, *, batch, seq_len, vocab, mask_prob):
 
 
 def make_source(config: TrainConfig, input_kind: str = "image",
-                sharding: Optional[jax.sharding.Sharding] = None):
+                sharding: Optional[jax.sharding.Sharding] = None,
+                objective: str = "classify"):
     """Synthetic source matching the *model's* input kind (not the dataset
     string, so `--model bert_base` works with default data settings)."""
     d: DataConfig = config.data
+    if input_kind == "tokens" and objective == "causal":
+        return SyntheticCausalTokens(
+            config.global_batch_size, d.seq_len, d.vocab_size,
+            config.seed, sharding)
     if input_kind == "tokens":
         return SyntheticTokens(
             config.global_batch_size, d.seq_len, d.vocab_size,
             d.mlm_mask_prob, config.seed, sharding)
     return SyntheticImages(
         config.global_batch_size, d.image_size, d.num_classes, config.seed,
-        sharding)
+        sharding, learnable=d.synthetic_learnable)

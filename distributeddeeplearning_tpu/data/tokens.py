@@ -84,7 +84,8 @@ def mask_batch(ids: np.ndarray, *, mask_prob: float, vocab_size: int,
 
 
 def _batch_stream(config: TrainConfig, *, train: bool,
-                  start_step: int) -> Iterator[dict]:
+                  start_step: int,
+                  objective: str = "mlm") -> Iterator[dict]:
     d = config.data
     proc, nproc = jax.process_index(), jax.process_count()
     per_process = config.global_batch_size // nproc
@@ -103,16 +104,24 @@ def _batch_stream(config: TrainConfig, *, train: bool,
             except StopIteration:
                 return  # finite (eval) stream drained mid-batch: drop remainder
         if step >= start_step:
-            # Mask keyed by (seed, step, proc): deterministic resume replay.
-            rng = np.random.default_rng(
-                (config.seed * 1_000_003 + step) * 4099 + proc)
-            yield mask_batch(np.stack(rows), mask_prob=d.mlm_mask_prob,
-                             vocab_size=d.vocab_size, rng=rng)
+            ids = np.stack(rows)
+            if objective == "causal":
+                # Causal LM consumes the raw packed ids; the loss shifts.
+                yield {"input_ids": ids,
+                       "attention_mask": (ids != PAD_ID).astype(np.int32)}
+            else:
+                # Mask keyed by (seed, step, proc): deterministic resume.
+                rng = np.random.default_rng(
+                    (config.seed * 1_000_003 + step) * 4099 + proc)
+                yield mask_batch(ids, mask_prob=d.mlm_mask_prob,
+                                 vocab_size=d.vocab_size, rng=rng)
         step += 1
 
 
 def make_token_source(config: TrainConfig, sharding, *, start_step: int = 0,
-                      train: bool = True) -> StreamSource:
-    it = _batch_stream(config, train=train, start_step=start_step)
+                      train: bool = True,
+                      objective: str = "mlm") -> StreamSource:
+    it = _batch_stream(config, train=train, start_step=start_step,
+                       objective=objective)
     return StreamSource(it, sharding, first_step=start_step,
                         depth=config.data.prefetch_depth)

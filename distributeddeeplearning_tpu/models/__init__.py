@@ -22,10 +22,13 @@ class ModelSpec:
     build: Callable[..., Any]          # (num_classes/vocab, dtype) -> nn.Module
     input_kind: str                    # "image" | "tokens"
     param_count: int                   # known-good total, used by tests
+    objective: str = "classify"        # classify | mlm | causal — selects the
+                                       # loss (train/steps.py) and, for token
+                                       # pipelines, masking vs plain ids
 
 
 def _registry() -> dict[str, ModelSpec]:
-    from distributeddeeplearning_tpu.models import bert, densenet, resnet
+    from distributeddeeplearning_tpu.models import bert, densenet, gpt, resnet
 
     def img(build, name, params):
         return ModelSpec(name=name, build=build, input_kind="image",
@@ -41,32 +44,43 @@ def _registry() -> dict[str, ModelSpec]:
         "densenet169": img(densenet.densenet169, "densenet169", 14_149_480),
         "bert_base": ModelSpec(
             name="bert_base", build=bert.bert_base_mlm, input_kind="tokens",
-            param_count=109_514_298),
+            param_count=109_514_298, objective="mlm"),
         "bert_large": ModelSpec(
             name="bert_large", build=bert.bert_large_mlm, input_kind="tokens",
-            param_count=335_174_458),
+            param_count=335_174_458, objective="mlm"),
+        # Decoder-only causal LMs (beyond reference scope): GPT-2 geometry,
+        # same trainer/sharding rules, causal Pallas flash kernel available.
+        "gpt2_small": ModelSpec(
+            name="gpt2_small", build=gpt.gpt2_small, input_kind="tokens",
+            param_count=124_439_808, objective="causal"),
+        "gpt2_medium": ModelSpec(
+            name="gpt2_medium", build=gpt.gpt2_medium, input_kind="tokens",
+            param_count=354_823_168, objective="causal"),
+        "gpt_tiny": ModelSpec(
+            name="gpt_tiny", build=gpt.tiny_gpt, input_kind="tokens",
+            param_count=0, objective="causal"),
         # BERT-base with a top-1-routed 8-expert MoE FFN every other layer
         # (models/moe.py), expert-parallel over the `expert` mesh axis.
         "bert_base_moe": ModelSpec(
-            name="bert_base_moe",
+            name="bert_base_moe", objective="mlm",
             build=lambda **kw: bert.bert_base_mlm(num_experts=8, **kw),
             input_kind="tokens", param_count=0),
         # Test/dry-run sized transformer; param_count=0 means "unchecked".
         "bert_tiny": ModelSpec(
             name="bert_tiny", build=bert.tiny_bert_mlm, input_kind="tokens",
-            param_count=0),
+            param_count=0, objective="mlm"),
         "bert_tiny_moe": ModelSpec(
-            name="bert_tiny_moe",
+            name="bert_tiny_moe", objective="mlm",
             build=lambda **kw: bert.tiny_bert_mlm(num_experts=4, **kw),
             input_kind="tokens", param_count=0),
         # BERT-base as a 4-stage GPipe pipeline over the `pipeline` axis.
         "bert_base_pp": ModelSpec(
-            name="bert_base_pp",
+            name="bert_base_pp", objective="mlm",
             build=lambda **kw: bert.bert_base_mlm(
                 pipeline_stages=4, pipeline_microbatches=8, **kw),
             input_kind="tokens", param_count=0),
         "bert_tiny_pp": ModelSpec(
-            name="bert_tiny_pp",
+            name="bert_tiny_pp", objective="mlm",
             build=lambda **kw: bert.tiny_bert_mlm(
                 pipeline_stages=2, pipeline_microbatches=4, **kw),
             input_kind="tokens", param_count=0),

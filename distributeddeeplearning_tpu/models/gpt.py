@@ -1,0 +1,199 @@
+"""GPT-2-style decoder-only causal LM in Flax, TPU-first.
+
+Beyond the reference's CNN+BERT scope: the causal counterpart to
+models/bert.py, sharing the same logical-axis sharding rules (tp via
+``heads``/``mlp``/``vocab``, sp activations, fsdp ``embed``) and the same
+train loop — one more family behind the one trainer. Pre-LN residual
+blocks, learned positions, gelu MLP, weight-tied LM head; the parameter
+layout matches the public GPT-2 124M checkpoint's shapes (param count
+asserted in tests).
+
+Attention: dense causal by default; ``attention_impl='flash'`` uses the
+Pallas kernel with ``causal=True`` (ops/flash_attention.py), which skips
+above-diagonal blocks — the long-context training path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position: int = 1024
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-5
+    attention_impl: str = "dense"   # dense | flash (causal Pallas kernel)
+    remat: bool = False
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+
+def _dense(features, logical_axes, name, dtype):
+    return nn.Dense(
+        features, dtype=dtype, param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), logical_axes),
+        name=name)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GptConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask, *, deterministic: bool):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        head_dim = cfg.hidden_size // cfg.num_heads
+        q = _dense(cfg.hidden_size, ("embed", "heads"), "query", self.dtype)(x)
+        k = _dense(cfg.hidden_size, ("embed", "heads"), "key", self.dtype)(x)
+        v = _dense(cfg.hidden_size, ("embed", "heads"), "value", self.dtype)(x)
+        q = q.reshape(b, s, cfg.num_heads, head_dim)
+        k = k.reshape(b, s, cfg.num_heads, head_dim)
+        v = v.reshape(b, s, cfg.num_heads, head_dim)
+
+        if (cfg.attention_impl != "dense" and cfg.dropout_rate > 0
+                and not deterministic):
+            # Trace-time warning (once per compile): flash never
+            # materializes the probs, so attention-prob dropout is skipped.
+            import warnings
+            warnings.warn(
+                f"attention_impl={cfg.attention_impl!r} does not apply "
+                f"attention-probability dropout; training regularization "
+                f"differs from 'dense' at dropout_rate={cfg.dropout_rate}. "
+                f"Residual/MLP dropouts still apply.", UserWarning,
+                stacklevel=2)
+        if cfg.attention_impl == "flash":
+            from distributeddeeplearning_tpu.ops.flash_attention import (
+                flash_attention_sharded)
+            out = flash_attention_sharded(
+                q, k, v, pad_mask, causal=True).reshape(b, s, -1)
+        elif cfg.attention_impl == "dense":
+            scale = head_dim ** -0.5
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            big_neg = jnp.finfo(jnp.float32).min
+            tri = jnp.tril(jnp.ones((s, s), jnp.bool_))
+            keep = tri[None, None] & pad_mask[:, None, None, :]
+            scores = jnp.where(keep, scores, big_neg)
+            probs = nn.softmax(
+                scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+            probs = nn.Dropout(cfg.dropout_rate)(
+                probs, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        else:
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}")
+        return _dense(cfg.hidden_size, ("heads", "embed"), "output",
+                      self.dtype)(out)
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN transformer block (GPT-2 ordering)."""
+
+    cfg: GptConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask, *, deterministic: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="ln1")(x)
+        h = CausalSelfAttention(cfg, self.dtype, name="attention")(
+            h, pad_mask, deterministic=deterministic)
+        x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="ln2")(x)
+        h = _dense(cfg.intermediate_size, ("embed", "mlp"), "mlp_in",
+                   self.dtype)(h)
+        h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
+        h = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out",
+                   self.dtype)(h)
+        return x + nn.Dropout(cfg.dropout_rate)(
+            h, deterministic=deterministic)
+
+
+class GptLM(nn.Module):
+    """Decoder-only LM; returns (B, S, vocab) f32 logits (tied head)."""
+
+    cfg: GptConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, *,
+                 train: bool = True):
+        cfg = self.cfg
+        deterministic = not train
+        b, s = input_ids.shape
+        if s > cfg.max_position:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position "
+                f"{cfg.max_position}; build the model with seq_len={s}")
+        pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
+                    else attention_mask.astype(jnp.bool_))
+
+        wte = self.param(
+            "wte", nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                                ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param(
+            "wpe", nn.with_logical_partitioning(nn.initializers.normal(0.01),
+                                                (None, "embed")),
+            (cfg.max_position, cfg.hidden_size), jnp.float32)
+        x = (wte[input_ids] + wpe[None, :s]).astype(self.dtype)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        for i in range(cfg.num_layers):
+            block = DecoderBlock(cfg, self.dtype, name=f"layer{i}")
+            if cfg.remat:
+                x = nn.remat(
+                    lambda mdl, h, m: mdl(h, m, deterministic=deterministic))(
+                    block, x, pad_mask)
+            else:
+                x = block(x, pad_mask, deterministic=deterministic)
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="ln_f")(x)
+        logits = jnp.einsum("bsh,vh->bsv", x, wte.astype(self.dtype))
+        return logits.astype(jnp.float32)
+
+
+def _fit_positions(cfg: GptConfig, seq_len: Optional[int]) -> GptConfig:
+    if seq_len and seq_len > cfg.max_position:
+        cfg = dataclasses.replace(cfg, max_position=seq_len)
+    return cfg
+
+
+def gpt2_small(vocab_size: int = 50257, dtype: Dtype = jnp.bfloat16,
+               seq_len: Optional[int] = None, **overrides: Any) -> GptLM:
+    """GPT-2 124M geometry (12L/768H/12 heads, 1024 positions)."""
+    cfg = GptConfig(vocab_size=vocab_size, **overrides)
+    return GptLM(_fit_positions(cfg, seq_len), dtype=dtype)
+
+
+def gpt2_medium(vocab_size: int = 50257, dtype: Dtype = jnp.bfloat16,
+                seq_len: Optional[int] = None, **overrides: Any) -> GptLM:
+    cfg = GptConfig(vocab_size=vocab_size, hidden_size=1024, num_layers=24,
+                    num_heads=16, **overrides)
+    return GptLM(_fit_positions(cfg, seq_len), dtype=dtype)
+
+
+def tiny_gpt(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
+             seq_len: Optional[int] = None, **overrides: Any) -> GptLM:
+    cfg = GptConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                    num_heads=4, **{"max_position": 128, **overrides})
+    return GptLM(_fit_positions(cfg, seq_len), dtype=dtype)

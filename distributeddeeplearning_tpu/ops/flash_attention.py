@@ -9,8 +9,10 @@ step — with the online-softmax running state (m, l, acc) carried across the
 K dimension in f32 VMEM scratch. HBM traffic is O(S·D) per Q-tile row and
 VMEM residency is O(BLOCK·D), so sequence length is bounded by HBM, not VMEM.
 
-Non-causal with a key-padding mask — exactly the attention BERT needs
-(models/bert.py). The backward pass recomputes block scores from the saved
+Key-padding mask, non-causal (BERT, models/bert.py) or causal
+(``causal=True`` — GPT, models/gpt.py; above-diagonal blocks are skipped
+entirely, halving FLOPs at large S). The backward pass recomputes block
+scores from the saved
 logsumexp (the flash recurrence) in two kernels: dq (accumulated over the
 K-tile grid axis) and dk/dv (accumulated over the Q-tile grid axis); the
 revisited output blocks stay resident in VMEM across the accumulation axis.
@@ -49,9 +51,17 @@ def _block(size: int, target: int) -> int:
 # Forward: grid (B*H, nQ, nK); m/l/acc scratch carries across the K axis.
 # ---------------------------------------------------------------------------
 
+def _tri_mask(i, j, bq, bk):
+    """Lower-triangular (col <= row) mask for the (i, j) block pair."""
+    row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return col <= row
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale: float):
-    j = pl.program_id(2)
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
+    i, j = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(j == 0)
     def _():
@@ -59,29 +69,39 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Matmul operands stay in their storage dtype (bf16 on the training
-    # path): the MXU takes bf16 inputs at full rate with f32 accumulation
-    # via preferred_element_type — upcasting first would halve MXU
-    # throughput and double VMEM traffic for zero precision gain.
-    q = q_ref[0]                                          # (BQ, D)
-    k = k_ref[0]                                          # (BK, D)
-    v = v_ref[0]
-    msk = mask_ref[0, 0] != 0                             # (BK,)
+    def work():
+        # Matmul operands stay in their storage dtype (bf16 on the training
+        # path): the MXU takes bf16 inputs at full rate with f32 accumulation
+        # via preferred_element_type — upcasting first would halve MXU
+        # throughput and double VMEM traffic for zero precision gain.
+        q = q_ref[0]                                      # (BQ, D)
+        k = k_ref[0]                                      # (BK, D)
+        v = v_ref[0]
+        valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
+        if causal:
+            valid = valid & _tri_mask(i, j, bq, bk)
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # (BQ, BK) f32
-    s = jnp.where(msk[None, :], s, _NEG)
-    m_prev = m_scr[:]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    p = jnp.where(msk[None, :], p, 0.0)
-    corr = jnp.exp(m_prev - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (BQ, BK) f32
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing — skip the
+        # matmuls entirely (halves causal FLOPs at large S).
+        pl.when(j * bk < (i + 1) * bq)(work)
+    else:
+        work()
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
@@ -94,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
             l[:, 0] > 0, m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
 
 
-def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret, causal):
     # Rank-1-per-tile operands (mask, lse) ride as (BH, 1, S) so every block
     # shape is rank >= 2 with a compiled-lowering-legal tail: Mosaic requires
     # the last two block dims be (multiples of, or equal to) the array dims —
@@ -103,7 +123,7 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
     bh, s, d = q.shape
     bq, bk = _block(s, block_q), _block(s, block_k)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=(bh, s // bq, s // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -137,33 +157,42 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale: float):
-    j = pl.program_id(2)
+               dq_ref, dq_scr, *, scale: float, causal: bool):
+    i, j = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(j == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
-    k = k_ref[0]
-    v = v_ref[0]
-    msk = mask_ref[0, 0] != 0
+    def work():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        k = k_ref[0]
+        v = v_ref[0]
+        valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
+        if causal:
+            valid = valid & _tri_mask(i, j, bq, bk)
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    s = jnp.where(msk[None, :], s, _NEG)
-    p = jnp.exp(s - lse)                                  # (BQ, BK)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta) * scale).astype(k.dtype)
-    dq_scr[:] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid, s, _NEG)
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * bk < (i + 1) * bq)(work)
+    else:
+        work()
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
@@ -171,37 +200,47 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float):
-    i = pl.program_id(2)
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool):
+    j, i = pl.program_id(1), pl.program_id(2)  # j: K tile; i: Q (accum) tile
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(i == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    k = k_ref[0]                                          # (BK, D)
-    v = v_ref[0]
-    msk = mask_ref[0, 0] != 0
-    q = q_ref[0]                                          # (BQ, D)
-    do = do_ref[0]
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    def work():
+        k = k_ref[0]                                      # (BK, D)
+        v = v_ref[0]
+        q = q_ref[0]                                      # (BQ, D)
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
+        if causal:
+            valid = valid & _tri_mask(i, j, bq, bk)
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    s = jnp.where(msk[None, :], s, _NEG)
-    p = jnp.exp(s - lse)                                  # (BQ, BK)
-    dv_scr[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta) * scale).astype(q.dtype)       # (BQ, BK)
-    dk_scr[:] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid, s, _NEG)
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)   # (BQ, BK)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * bk < (i + 1) * bq)(work)
+    else:
+        work()
 
     @pl.when(i == pl.num_programs(2) - 1)
     def _():
@@ -209,7 +248,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, block_q, block_k, interpret, residuals, g):
+def _bwd(scale, block_q, block_k, interpret, causal, residuals, g):
     q, k, v, mask, out, lse = residuals
     bh, s, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -223,7 +262,7 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
     vec_q = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale),
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
         grid=(bh, s // bq, s // bk),
         in_specs=[q_tile, k_tile, k_tile, maskk, q_tile, vec_q, vec_q],
         out_specs=[q_tile],
@@ -241,7 +280,7 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
     maskk2 = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))
     vec_q2 = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
         grid=(bh, s // bk, s // bq),
         in_specs=[q_acc, k_out, k_out, maskk2, q_acc, vec_q2, vec_q2],
         out_specs=[k_out, k_out],
@@ -256,16 +295,16 @@ def _bwd(scale, block_q, block_k, interpret, residuals, g):
     return dq, dk, dv, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, scale, block_q, block_k, interpret, causal):
     out, _ = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
-                  block_k=block_k, interpret=interpret)
+                  block_k=block_k, interpret=interpret, causal=causal)
     return out
 
 
-def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret, causal):
     out, lse = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
-                    block_k=block_k, interpret=interpret)
+                    block_k=block_k, interpret=interpret, causal=causal)
     return out, (q, k, v, mask, out, lse)
 
 
@@ -273,9 +312,10 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 512,
-                    block_k: int = 1024,
+                    block_k: int = 1024, causal: bool = False,
                     interpret: Optional[bool] = None):
-    """Fused non-causal attention with a key-padding mask.
+    """Fused attention with a key-padding mask; ``causal=True`` adds the
+    autoregressive lower-triangular mask (and skips above-diagonal blocks).
 
     q/k/v: (B, S, H, D) — the models' layout; kv_mask: (B, S) (True/nonzero
     = attend), or None for all-valid. Returns (B, S, H, D) in q.dtype.
@@ -293,7 +333,7 @@ def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 512,
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     out = _flash(to_bh(q), to_bh(k), to_bh(v), kv_mask,
-                 d ** -0.5, block_q, block_k, interpret)
+                 d ** -0.5, block_q, block_k, interpret, causal)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

@@ -115,11 +115,13 @@ def build(config: TrainConfig, total_steps: int):
     if uses_gspmd(config, spec.input_kind):
         # Shapes-only example for init; synthetic regardless of data mode.
         example = synthetic.make_source(
-            config, spec.input_kind, sharding=batch_shd).batch(0)
+            config, spec.input_kind, sharding=batch_shd,
+            objective=spec.objective).batch(0)
         state, shardings = steps.init_sharded_state(
             model, tx, mesh, config, example, rng, spec.input_kind)
         train_step = steps.make_gspmd_train_step(
-            model, tx, mesh, config, shardings, spec.input_kind)
+            model, tx, mesh, config, shardings, spec.input_kind,
+            spec.objective)
     else:
         def init_fn(rng):
             if spec.input_kind == "tokens":
@@ -140,7 +142,7 @@ def build(config: TrainConfig, total_steps: int):
         replicated = shardlib.replicated(mesh)
         state = jax.jit(init_fn, out_shardings=replicated)(rng)
         train_step = steps.make_dp_train_step(
-            model, tx, mesh, config, spec.input_kind)
+            model, tx, mesh, config, spec.input_kind, spec.objective)
 
     return mesh, model, batch_shd, state, train_step, sched, rng
 
@@ -204,7 +206,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     # starts at the resume step rather than replaying from zero. A run with
     # no steps left skips pipeline construction entirely.
     source = (datalib.make_source(
-        config, spec.input_kind, batch_shd, start_step=start_step)
+        config, spec.input_kind, batch_shd, start_step=start_step,
+        objective=spec.objective)
         if start_step < total_steps else None)
     # A resumed run may have fewer than warmup_steps left to execute (or
     # none at all, when the checkpoint already passed total_steps).

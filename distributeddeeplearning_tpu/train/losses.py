@@ -41,3 +41,25 @@ def mlm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     total = (per_tok * weights).sum()
     denom = jnp.maximum(weights.sum(), 1.0)
     return total / denom
+
+
+def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
+                   attention_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Next-token cross entropy: logits[:, t] predicts input_ids[:, t+1].
+
+    Padding positions (attention_mask == 0) are excluded from both sides of
+    the shift. Mean over predicted tokens.
+    """
+    shift_logits = logits[:, :-1]
+    targets = input_ids[:, 1:]
+    if attention_mask is None:
+        weights = jnp.ones(targets.shape, jnp.float32)
+    else:
+        # Both sides of the shift must be real tokens: a padded *query*
+        # position produces a garbage (uniform-over-everything) logit row,
+        # so its prediction must not be scored even when the target is real.
+        mask = attention_mask.astype(jnp.float32)
+        weights = mask[:, :-1] * mask[:, 1:]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        shift_logits, targets)
+    return (per_tok * weights).sum() / jnp.maximum(weights.sum(), 1.0)

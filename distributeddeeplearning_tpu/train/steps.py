@@ -88,10 +88,29 @@ def _token_loss_fn(model, config: TrainConfig):
     return loss_fn
 
 
-def loss_fn_for(model, input_kind: str, config: TrainConfig):
+def _causal_loss_fn(model, config: TrainConfig):
+    del config
+
+    def loss_fn(params, batch_stats, batch, rng):
+        del batch_stats
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            train=True, rngs={"dropout": rng})
+        loss = losses.causal_lm_loss(
+            logits, batch["input_ids"], batch.get("attention_mask"))
+        return loss, (None, {"loss": loss})
+
+    return loss_fn
+
+
+def loss_fn_for(model, input_kind: str, config: TrainConfig,
+                objective: str = "classify"):
     if input_kind == "image":
         return _image_loss_fn(model, config)
     if input_kind == "tokens":
+        if objective == "causal":
+            return _causal_loss_fn(model, config)
         return _token_loss_fn(model, config)
     raise ValueError(f"unknown input kind {input_kind!r}")
 
@@ -153,7 +172,8 @@ def accumulated_grads(loss_fn, params, batch_stats, batch, rng, accum: int,
 # ---------------------------------------------------------------------------
 
 def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
-                       config: TrainConfig, input_kind: str = "image"
+                       config: TrainConfig, input_kind: str = "image",
+                       objective: str = "classify"
                        ) -> Callable[[TrainState, Any, jax.Array],
                                      tuple[TrainState, dict]]:
     """Build the jitted data-parallel train step.
@@ -163,7 +183,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     — the exact allreduce-average Horovod performs — so parameters stay
     bit-identical on every shard.
     """
-    loss_fn = loss_fn_for(model, input_kind, config)
+    loss_fn = loss_fn_for(model, input_kind, config, objective)
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
     accum = config.grad_accum_steps
 
@@ -269,8 +289,9 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
 
 
 def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
-                          state_shardings, input_kind: str = "tokens"):
-    loss_fn = loss_fn_for(model, input_kind, config)
+                          state_shardings, input_kind: str = "tokens",
+                          objective: str = "mlm"):
+    loss_fn = loss_fn_for(model, input_kind, config, objective)
     # Token batches are (B, S): dim 0 over the DP axes, dim 1 over `seq`.
     seq_dim = 1 if input_kind == "tokens" else None
     batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)

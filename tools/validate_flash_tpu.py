@@ -98,6 +98,27 @@ def main() -> int:
     print(json.dumps({"check": "backward", "rel_err": gerrs, "ok": ok_bwd}),
           flush=True)
 
+    # Causal path (GPT): compiled kernel vs causal dense reference.
+    def dense_causal(q, k, v, mask):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (d ** -0.5)
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        keep = mask[:, None, None, :] & tri[None, None]
+        s = jnp.where(keep, s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    flash_c = jax.jit(functools.partial(flash_attention, interpret=False,
+                                        causal=True))
+    out_fc = np.asarray(flash_c(q, k, v, mask), np.float32)
+    out_dc = np.asarray(jax.jit(dense_causal)(q, k, v, mask), np.float32)
+    causal_err = float(np.abs((out_fc - out_dc) * valid).max())
+    ok_causal = causal_err < 2e-2
+    print(json.dumps({"check": "causal_forward", "max_abs_err": causal_err,
+                      "ok": ok_causal}), flush=True)
+
     t_flash = timed(flash, q, k, v, mask)
     t_dense = timed(dense, q, k, v, mask)
     grad_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
@@ -111,7 +132,7 @@ def main() -> int:
         "fwd_bwd_ms": {"flash": round(t_flash_bwd * 1e3, 3),
                        "dense": round(t_dense_bwd * 1e3, 3)},
     }), flush=True)
-    return 0 if (ok_fwd and ok_bwd) else 1
+    return 0 if (ok_fwd and ok_bwd and ok_causal) else 1
 
 
 if __name__ == "__main__":

@@ -169,6 +169,30 @@ def run_local(num_processes: int, command: Sequence[str], *,
     return monitor(children)
 
 
+def run_with_restarts(run_once, max_restarts: int, *,
+                      backoff_s: float = 3.0) -> int:
+    """Fail-whole + auto-relaunch: the in-launcher restart wrapper.
+
+    The reference's failure story was "mpirun dies whole, Batch AI resubmits
+    the job" (SURVEY.md §5.3); ``run_once`` is one whole-job attempt, and a
+    nonzero exit relaunches it up to ``max_restarts`` times. Paired with
+    checkpoint-resume (train/checkpoint.py restores latest and the data
+    stream repositions), each relaunch continues from the last saved step.
+    Interrupts (rc 130) are the operator stopping the job — never retried.
+    """
+    attempt = 0
+    while True:
+        rc = run_once()
+        if rc == 0 or rc == 130 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print(f"# launcher: job failed (rc={rc}); restart "
+              f"{attempt}/{max_restarts} in {backoff_s:.0f}s "
+              f"(resumes from the latest checkpoint)",
+              file=sys.stderr, flush=True)
+        time.sleep(backoff_s)
+
+
 def run_from_hostfile(path: str, process_id: int, command: Sequence[str], *,
                       port: int = 9531) -> int:
     """Run this host's single process of a hostfile-defined job."""
@@ -195,6 +219,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="this host's line number in --hostfile")
     p.add_argument("--port", type=int, default=9531,
                    help="coordinator port")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="relaunch the whole job up to N times after a "
+                        "failure (resumes from the latest checkpoint)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -208,10 +235,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.hostfile:
         if args.process_id is None:
             p.error("--hostfile requires --process-id")
+        if args.max_restarts:
+            # A per-host restart decision is wrong for a whole-job semantic:
+            # hosts whose rank exited 0 would never relaunch, leaving the
+            # restarted ranks hung in rendezvous. Multi-host restart needs a
+            # whole-job resubmit (every host's launcher rerun), like the
+            # reference's Batch-AI resubmission.
+            p.error("--max-restarts only supports local (--num-processes) "
+                    "jobs; for --hostfile, wrap the launcher in a "
+                    "whole-job resubmit loop on every host")
         return run_from_hostfile(args.hostfile, args.process_id, command,
                                  port=args.port)
     n = args.num_processes or 1
-    return run_local(n, command, port=args.port)
+    return run_with_restarts(
+        lambda: run_local(n, command, port=args.port), args.max_restarts)
 
 
 if __name__ == "__main__":

@@ -33,7 +33,8 @@ class GptConfig:
     max_position: int = 1024
     dropout_rate: float = 0.1
     layer_norm_eps: float = 1e-5
-    attention_impl: str = "dense"   # dense | flash (causal Pallas kernel)
+    attention_impl: str = "dense"   # dense | flash (causal Pallas kernel) |
+                                    # ring (causal ring over the `seq` axis)
     remat: bool = False
 
     @property
@@ -80,6 +81,12 @@ class CausalSelfAttention(nn.Module):
             from distributeddeeplearning_tpu.ops.flash_attention import (
                 flash_attention_sharded)
             out = flash_attention_sharded(
+                q, k, v, pad_mask, causal=True).reshape(b, s, -1)
+        elif cfg.attention_impl == "ring":
+            # Causal ring: sequence sharded over the `seq` mesh axis,
+            # masking by global position per ring step — long-context GPT.
+            from distributeddeeplearning_tpu.parallel import ring_attention
+            out = ring_attention.ring_attention_sharded(
                 q, k, v, pad_mask, causal=True).reshape(b, s, -1)
         elif cfg.attention_impl == "dense":
             scale = head_dim ** -0.5

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributeddeeplearning_tpu.ops.masks import block_causal_mask
+
 _NEG = -1e30
 
 
@@ -50,13 +52,6 @@ def _block(size: int, target: int) -> int:
 # ---------------------------------------------------------------------------
 # Forward: grid (B*H, nQ, nK); m/l/acc scratch carries across the K axis.
 # ---------------------------------------------------------------------------
-
-def _tri_mask(i, j, bq, bk):
-    """Lower-triangular (col <= row) mask for the (i, j) block pair."""
-    row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return col <= row
-
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
@@ -79,7 +74,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         v = v_ref[0]
         valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
         if causal:
-            valid = valid & _tri_mask(i, j, bq, bk)
+            valid = valid & block_causal_mask(i, j, bq, bk)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -174,7 +169,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0]
         valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
         if causal:
-            valid = valid & _tri_mask(i, j, bq, bk)
+            valid = valid & block_causal_mask(i, j, bq, bk)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -219,7 +214,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, None]
         valid = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :], (bq, bk))
         if causal:
-            valid = valid & _tri_mask(i, j, bq, bk)
+            valid = valid & block_causal_mask(i, j, bq, bk)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),

@@ -28,25 +28,32 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distributeddeeplearning_tpu.ops.masks import block_causal_mask
+
 # Large-negative instead of -inf: keeps exp() exactly 0 without inf-inf NaN
 # hazards in the running-max recurrence.
 _NEG = -1e30
 
 
-def _block_update(q, k, v, kv_mask, m, l, acc, scale):
+def _block_update(q, k, v, kv_mask, m, l, acc, scale, tri=None):
     """One online-softmax accumulation step against a K/V block.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); kv_mask: (B, Sk) True=attend.
+    ``tri``: optional (Sq, Sk) bool causal mask for this block pair.
     Running state m, l: (B, H, Sq); acc: (B, H, Sq, D), all float32.
     """
+    keep = jnp.broadcast_to(kv_mask[:, None, None, :],
+                            (q.shape[0], 1, q.shape[1], k.shape[1]))
+    if tri is not None:
+        keep = keep & tri[None, None]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
-    s = jnp.where(kv_mask[:, None, None, :], s, _NEG)
+    s = jnp.where(keep, s, _NEG)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # Re-mask after exp: a fully-masked block would otherwise contribute
     # exp(_NEG - _NEG) = 1 per key.
     p = jnp.exp(s - m_new[..., None])
-    p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    p = jnp.where(keep, p, 0.0)
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
     acc_new = acc * corr[..., None] + jnp.einsum(
@@ -54,14 +61,22 @@ def _block_update(q, k, v, kv_mask, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
-def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
-    """Exact (non-causal) attention over a ring of sequence shards.
+def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
+                   causal: bool = False):
+    """Exact attention over a ring of sequence shards (optionally causal).
 
     Call under ``shard_map`` with the sequence dim sharded on ``axis_name``.
     Shapes (per shard): q/k/v (B, S_local, H, D); kv_mask (B, S_local) bool.
     Returns (B, S_local, H, D) in q.dtype. Collapses to one local block (no
     permutes) when the axis has size 1, so the same code path serves
     single-chip runs.
+
+    ``causal=True`` masks by *global* sequence position: ring step r brings
+    shard ``(i - r) mod n``'s K/V to shard i, so each block pair gets the
+    (Sq, Sk) triangle of ``kv_pos <= q_pos`` — full for past blocks, the
+    diagonal triangle for the local block, empty for future blocks (their
+    arrivals are fully masked; the permutes still run, keeping the ring
+    schedule uniform).
     """
     b, sq, h, d = q.shape
     scale = d ** -0.5
@@ -70,21 +85,25 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
     kv_mask = kv_mask.astype(jnp.bool_)
+    idx = lax.axis_index(axis_name) if causal else None
 
     # Local block first, outside the loop: it both seeds the carry with the
     # right varying-axes type (the NEG/zero inits are unvarying constants,
     # which shard_map's loop typing rejects as a carry) and leaves exactly
     # n-1 permutes in the ring.
-    m, l, acc = _block_update(q, k, v, kv_mask, m, l, acc, scale)
+    tri = block_causal_mask(idx, idx, sq, sq) if causal else None
+    m, l, acc = _block_update(q, k, v, kv_mask, m, l, acc, scale, tri)
     if n > 1:
         perm = [(i, (i + 1) % n) for i in range(n)]
 
-        def body(_, carry):
+        def body(r, carry):
             m, l, acc, k, v, msk = carry
             # Rotate K/V (and their padding mask) one ICI neighbour along
             # the ring, then fold the arriving block into the running state.
             k, v, msk = lax.ppermute((k, v, msk), axis_name, perm)
-            m, l, acc = _block_update(q, k, v, msk, m, l, acc, scale)
+            tri = (block_causal_mask(idx, (idx - r) % n, sq, sq)
+                   if causal else None)
+            m, l, acc = _block_update(q, k, v, msk, m, l, acc, scale, tri)
             return m, l, acc, k, v, msk
 
         m, l, acc, *_ = lax.fori_loop(
@@ -98,7 +117,8 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
                            mesh: Optional[jax.sharding.Mesh] = None,
                            seq_axis: str = "seq",
                            batch_axes=("data", "fsdp"),
-                           head_axis: str = "model"):
+                           head_axis: str = "model",
+                           causal: bool = False):
     """GSPMD-embeddable wrapper: shard_map over (batch, seq, heads).
 
     Takes *global* (B, S, H, D) arrays inside a jit-traced program (ambient
@@ -112,10 +132,10 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
         if ambient is None or ambient.empty:
             # No mesh context (single-device apply / notebook use): one local
             # block is the whole ring.
-            return _local_attention(q, k, v, kv_mask)
+            return _local_attention(q, k, v, kv_mask, causal=causal)
     qkv_spec = P(batch_axes, seq_axis, head_axis, None)
     mask_spec = P(batch_axes, seq_axis)
-    fn = functools.partial(ring_attention, axis_name=seq_axis)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
@@ -123,14 +143,15 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
     return mapped(q, k, v, kv_mask)
 
 
-def _local_attention(q, k, v, kv_mask):
+def _local_attention(q, k, v, kv_mask, *, causal: bool = False):
     """The ring's single-block case without a mesh: one _block_update pass
     (still exact, still O(S) memory in scores per block — here S is global)."""
     b, sq, h, d = q.shape
     m = jnp.full((b, h, sq), _NEG, jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    tri = block_causal_mask(0, 0, sq, sq) if causal else None
     m, l, acc = _block_update(q, k, v, kv_mask.astype(jnp.bool_), m, l, acc,
-                              d ** -0.5)
+                              d ** -0.5, tri)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

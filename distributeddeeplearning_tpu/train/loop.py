@@ -299,6 +299,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         "start_step": start_step,
         "final_metrics": {k: float(v) for k, v in metrics.items()},
     }
+    hbm = _device_memory_stats()
+    if hbm:
+        summary["memory"] = hbm
     if t_timed is not None and timed_examples:
         elapsed = time.perf_counter() - t_timed
         summary["examples_per_sec"] = timed_examples / elapsed
@@ -315,6 +318,23 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if return_state:
         summary["state"] = state
     return summary
+
+
+def _device_memory_stats() -> Optional[dict]:
+    """Peak/current HBM on local device 0 (None where the backend doesn't
+    report, e.g. CPU). The observability counterpart of nvidia-smi in the
+    reference's stack."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out or None
 
 
 class _Profiler:

@@ -28,7 +28,7 @@ import numpy as np
 
 def make_corpus(root: str, n: int, hw: int = 400) -> None:
     """n JPEGs in an image-folder layout (2 classes), ~ImageNet-sized."""
-    from PIL import Image  # pillow ships with tf; fall back below if absent
+    from PIL import Image  # ships alongside tf in this stack
 
     rng = np.random.default_rng(0)
     for i in range(n):
@@ -90,7 +90,13 @@ def main(argv=None) -> int:
     else:
         cleanup = tempfile.TemporaryDirectory(prefix="ddl_loaderbench_")
         data_dir = os.path.join(cleanup.name, "train")
-        make_corpus(data_dir, args.images)
+        try:
+            make_corpus(data_dir, args.images)
+        except Exception as e:  # keep the one-JSON-line-per-item contract
+            print(json.dumps({"pipeline": "corpus_generation",
+                              "error": str(e)[-300:]}), flush=True)
+            cleanup.cleanup()
+            return 1
         data_dir = cleanup.name
 
     for name, fn in [("native_cc", bench_native), ("tf_data", bench_tf)]:

@@ -159,15 +159,12 @@ def run(config: TrainConfig, *, total_steps: int,
     ``checkpoint_every_steps`` (async) plus a final save, and — when
     ``config.resume`` — restores the newest checkpoint and continues from
     its step, replaying the deterministic data stream from there.
-    ``eval_batches > 0`` runs a sharded top-1 eval after training
-    (SURVEY.md §3.5) on image models.
+    ``eval_batches > 0`` enables periodic + final held-out eval
+    (SURVEY.md §3.5): sharded top-1 for image models, mean per-token loss
+    (perplexity) for token models.
     """
     logger = logger or MetricLogger()
     spec = model_spec(config.model)
-    if eval_batches > 0 and spec.input_kind != "image":
-        raise ValueError(
-            "eval_batches (top-1 eval) only applies to image models; "
-            f"{config.model!r} is a {spec.input_kind!r} model")
     mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
 
@@ -228,8 +225,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     evaluator = None
     eval_every_steps = 0
     evals: list[tuple[int, float]] = []
-    if eval_batches > 0 and spec.input_kind == "image":
-        evaluator = _Evaluator(config, mesh, model, batch_shd, eval_batches)
+    if eval_batches > 0:
+        if spec.input_kind == "image":
+            evaluator = _Evaluator(config, mesh, model, batch_shd,
+                                   eval_batches)
+        else:
+            evaluator = _TokenEvaluator(config, spec, mesh, model, batch_shd,
+                                        eval_batches, state)
         if config.eval_every_epochs > 0:
             spe = steps_per_epoch(config)
             if spe is not None:
@@ -266,9 +268,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             if (eval_every_steps and (i + 1) % eval_every_steps == 0
                     and i + 1 < total_steps):
                 t_eval = time.perf_counter()
-                top1 = evaluator(state)
-                evals.append((i + 1, top1))
-                logger.log(int(i + 1), {"eval_top1": top1})
+                val = evaluator(state)
+                evals.append((i + 1, val))
+                logger.log(int(i + 1), {evaluator.metric_name: val})
                 if t_timed is not None:
                     # Keep throughput numbers about training: shift the
                     # timing origin past the eval pause.
@@ -310,11 +312,16 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         summary["steps_per_sec"] = (
             total_steps - start_step - warmup_steps) / elapsed
     if evaluator is not None:
-        final_top1 = evaluator(state)
-        evals.append((end_step, final_top1))
-        summary["eval_top1"] = final_top1
-        summary["best_top1"] = max(t for _, t in evals)
+        final_val = evaluator(state)
+        evals.append((end_step, final_val))
+        summary[evaluator.metric_name] = final_val
+        best = evaluator.best(t for _, t in evals)
+        summary["best_" + evaluator.metric_name.removeprefix("eval_")] = best
         summary["evals"] = evals
+        if evaluator.metric_name == "eval_loss":
+            import math
+
+            summary["eval_ppl"] = math.exp(min(final_val, 30.0))
     if return_state:
         summary["state"] = state
     return summary
@@ -376,50 +383,96 @@ class _Profiler:
               file=sys.stderr, flush=True)
 
 
-class _Evaluator:
-    """Sharded top-1 over ``num_batches``: per-shard correct counts are
-    psummed across the DP axes before dividing (SURVEY.md §3.5), so the
-    result is identical to a single-device pass over the global batch.
+class _EvaluatorBase:
+    """Shared held-out-eval plumbing (SURVEY.md §3.5).
 
     Built once per run — the compiled eval step is reused across every
     periodic (epoch-boundary) and final invocation. The synthetic source is
-    indexable and also reused; a real validation split is a *finite ordered
-    stream*, so a fresh source is built per invocation (each eval reads the
-    split from its start).
-
-    Synthetic mode evaluates at a fixed huge batch-index offset
-    (``SYNTHETIC_EVAL_OFFSET``), disjoint from any training step index, so
+    indexable and reused, evaluating at a fixed huge batch-index offset
+    (``SYNTHETIC_EVAL_OFFSET``) disjoint from any training step index, so
     eval batches never replay training batches and every eval scores the
-    same held-out set.
+    same held-out set. A real validation split is a *finite ordered
+    stream*, so a fresh source is built per invocation (each eval reads the
+    split from its start) with prefetch_depth=0 — construction must not
+    eagerly decode lookahead batches a short eval would throw away.
+
+    Subclasses set ``metric_name``/``best``, build ``self.eval_step``, and
+    implement ``_accumulate`` over the per-batch eval-step outputs.
     """
 
     SYNTHETIC_EVAL_OFFSET = 1 << 30
+    input_kind: str
+    objective: str = "classify"
 
-    def __init__(self, config: TrainConfig, mesh, model, batch_shd,
-                 num_batches: int):
+    def __init__(self, config: TrainConfig, batch_shd, num_batches: int):
         self.num_batches = num_batches
-        self.eval_step = steps.make_dp_eval_step(model, mesh, config)
         self.synthetic = config.data.synthetic or not config.data.data_dir
         self._config, self._batch_shd = config, batch_shd
         self._synth_source = (
-            datalib.make_source(config, "image", batch_shd)
+            datalib.make_source(config, self.input_kind, batch_shd,
+                                objective=self.objective)
             if self.synthetic else None)
 
-    def __call__(self, state) -> float:
+    def _source_and_offset(self):
         if self.synthetic:
-            source, offset = self._synth_source, self.SYNTHETIC_EVAL_OFFSET
-        else:
-            # Fresh finite stream per eval; prefetch_depth=0 so construction
-            # doesn't eagerly decode lookahead batches that a short
-            # (num_batches-bounded) eval would then throw away.
-            import dataclasses
-            cfg = self._config.replace(data=dataclasses.replace(
-                self._config.data, prefetch_depth=0))
-            source, offset = datalib.make_source(
-                cfg, "image", self._batch_shd, train=False), 0
+            return self._synth_source, self.SYNTHETIC_EVAL_OFFSET
+        import dataclasses
+        cfg = self._config.replace(data=dataclasses.replace(
+            self._config.data, prefetch_depth=0))
+        return datalib.make_source(
+            cfg, self.input_kind, self._batch_shd, train=False,
+            objective=self.objective), 0
+
+    def __call__(self, state) -> float:
+        source, offset = self._source_and_offset()
+        outs = (jax.device_get(self.eval_step(state, source.batch(offset + j)))
+                for j in range(self.num_batches))
+        return self._accumulate(outs)
+
+
+class _TokenEvaluator(_EvaluatorBase):
+    """Held-out LM eval for token models: mean per-token loss over
+    ``num_batches`` (perplexity = exp(loss)), computed with dropout off and
+    exact (loss_sum, token_count) aggregation — identical to a
+    single-device pass under any sharding. ``best`` is ``min``."""
+
+    metric_name = "eval_loss"
+    best = staticmethod(min)
+    input_kind = "tokens"
+
+    def __init__(self, config: TrainConfig, spec, mesh, model, batch_shd,
+                 num_batches: int, state):
+        self.objective = spec.objective
+        super().__init__(config, batch_shd, num_batches)
+        shardings = jax.tree_util.tree_map(lambda x: x.sharding, state)
+        self.eval_step = steps.make_token_eval_step(
+            model, mesh, config, shardings, spec.objective)
+
+    def _accumulate(self, outs) -> float:
+        loss_sum = count = 0.0
+        for out in outs:
+            loss_sum += float(out["loss_sum"])
+            count += float(out["count"])
+        return loss_sum / max(count, 1.0)
+
+
+class _Evaluator(_EvaluatorBase):
+    """Sharded top-1 over ``num_batches``: per-shard correct counts are
+    psummed across the DP axes before dividing, so the result is identical
+    to a single-device pass over the global batch."""
+
+    metric_name = "eval_top1"
+    best = staticmethod(max)
+    input_kind = "image"
+
+    def __init__(self, config: TrainConfig, mesh, model, batch_shd,
+                 num_batches: int):
+        super().__init__(config, batch_shd, num_batches)
+        self.eval_step = steps.make_dp_eval_step(model, mesh, config)
+
+    def _accumulate(self, outs) -> float:
         correct = total = 0
-        for j in range(self.num_batches):
-            counts = self.eval_step(state, source.batch(offset + j))
-            correct += int(jax.device_get(counts["correct"]))
-            total += int(jax.device_get(counts["total"]))
+        for out in outs:
+            correct += int(out["correct"])
+            total += int(out["total"])
         return correct / max(total, 1)

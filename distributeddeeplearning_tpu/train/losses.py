@@ -28,38 +28,47 @@ def top1_accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
 
 
-def mlm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Masked-LM cross entropy over positions with label >= 0.
+def mlm_loss_sums(logits: jnp.ndarray, labels: jnp.ndarray):
+    """(sum of per-token CE over masked positions, masked-position count).
 
     ``labels`` is (B, S) int32 with -1 at unmasked positions (the ignore
-    index). Mean over masked positions, guarded against an all-unmasked batch.
+    index). The sum form aggregates exactly across shards/batches (eval
+    perplexity); :func:`mlm_loss` is its mean.
     """
     weights = (labels >= 0).astype(jnp.float32)
-    safe_labels = jnp.maximum(labels, 0)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(
-        logits, safe_labels)
-    total = (per_tok * weights).sum()
-    denom = jnp.maximum(weights.sum(), 1.0)
-    return total / denom
+        logits, jnp.maximum(labels, 0))
+    return (per_tok * weights).sum(), weights.sum()
+
+
+def mlm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Masked-LM cross entropy: mean over masked positions, guarded
+    against an all-unmasked batch."""
+    total, count = mlm_loss_sums(logits, labels)
+    return total / jnp.maximum(count, 1.0)
+
+
+def causal_lm_loss_sums(logits: jnp.ndarray, input_ids: jnp.ndarray,
+                        attention_mask: jnp.ndarray | None = None):
+    """(sum of next-token CE, predicted-token count): logits[:, t] predicts
+    input_ids[:, t+1].
+
+    Both sides of the shift must be real tokens: a padded *query* position
+    produces a garbage (uniform-over-everything) logit row, so its
+    prediction must not be scored even when the target is real.
+    """
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], input_ids[:, 1:])
+    if attention_mask is None:
+        weights = jnp.ones(per_tok.shape, jnp.float32)
+    else:
+        mask = attention_mask.astype(jnp.float32)
+        weights = mask[:, :-1] * mask[:, 1:]
+    return (per_tok * weights).sum(), weights.sum()
 
 
 def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
                    attention_mask: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Next-token cross entropy: logits[:, t] predicts input_ids[:, t+1].
-
-    Padding positions (attention_mask == 0) are excluded from both sides of
-    the shift. Mean over predicted tokens.
-    """
-    shift_logits = logits[:, :-1]
-    targets = input_ids[:, 1:]
-    if attention_mask is None:
-        weights = jnp.ones(targets.shape, jnp.float32)
-    else:
-        # Both sides of the shift must be real tokens: a padded *query*
-        # position produces a garbage (uniform-over-everything) logit row,
-        # so its prediction must not be scored even when the target is real.
-        mask = attention_mask.astype(jnp.float32)
-        weights = mask[:, :-1] * mask[:, 1:]
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(
-        shift_logits, targets)
-    return (per_tok * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    """Next-token cross entropy, mean over predicted tokens."""
+    total, count = causal_lm_loss_sums(logits, input_ids, attention_mask)
+    return total / jnp.maximum(count, 1.0)

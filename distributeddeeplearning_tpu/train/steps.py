@@ -225,6 +225,40 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     return jax.jit(mapped, donate_argnums=0)
 
 
+def make_token_eval_step(model, mesh: Mesh, config: TrainConfig,
+                         state_shardings, objective: str = "mlm"):
+    """Held-out LM eval (GSPMD): per-batch (loss_sum, token_count) with
+    dropout off — exact aggregation across any sharding, so perplexity is
+    identical to a single-device pass (the token analogue of the image
+    path's psum'd correct-counts, SURVEY.md §3.5)."""
+
+    def eval_fn(state: TrainState, batch):
+        with _unreplicated_rules_ctx(config):
+            logits = model.apply(
+                {"params": state.params}, batch["input_ids"],
+                attention_mask=batch.get("attention_mask"), train=False)
+        if objective == "causal":
+            s, n = losses.causal_lm_loss_sums(
+                logits, batch["input_ids"], batch.get("attention_mask"))
+        else:
+            s, n = losses.mlm_loss_sums(logits, batch["labels"])
+        return {"loss_sum": s, "count": n}
+
+    jit_cache: dict = {}
+
+    def compiled(state, batch):
+        key = jax.tree_util.tree_structure(batch)
+        if key not in jit_cache:
+            jit_cache[key] = jax.jit(
+                eval_fn,
+                in_shardings=(state_shardings, None),
+                out_shardings=NamedSharding(mesh, P()))
+        with use_mesh(mesh):
+            return jit_cache[key](state, batch)
+
+    return compiled
+
+
 def make_dp_eval_step(model, mesh: Mesh, config: TrainConfig):
     """Eval: per-shard correct-count, psum before dividing (SURVEY.md §3.5)."""
     del config

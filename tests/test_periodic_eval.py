@@ -76,3 +76,51 @@ def test_learnable_synthetic_reaches_high_top1():
                        logger=MetricLogger(enabled=False))
     assert summary["best_top1"] > 0.6, summary  # chance = 0.25
     assert len(summary["evals"]) >= 3  # periodic evals fired
+
+
+def test_token_eval_perplexity():
+    """Token models get held-out eval too: periodic eval_loss fires, the
+    summary carries best_loss + eval_ppl, and on random synthetic tokens
+    the per-token loss sits near ln(vocab)."""
+    import math
+
+    import numpy as np
+
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    cfg = TrainConfig(
+        model="bert_tiny", global_batch_size=8, dtype="float32",
+        log_every=10**9, steps_per_epoch=3, eval_every_epochs=1.0,
+        parallel=ParallelConfig(data=2, model=2, seq=2),
+        data=DataConfig(dataset="mlm", seq_len=32, vocab_size=512),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-4,
+                                  schedule="linear", label_smoothing=0.0))
+    summary = loop.run(cfg, total_steps=7, eval_batches=2,
+                       logger=MetricLogger(enabled=False))
+    assert len(summary["evals"]) >= 3       # steps 3, 6 + final
+    assert np.isfinite(summary["eval_loss"])
+    assert summary["best_loss"] <= summary["evals"][0][1] + 1e-6
+    assert abs(summary["eval_loss"] - math.log(512)) < 1.5
+    assert summary["eval_ppl"] > 1.0
+
+
+def test_token_eval_causal(devices8):
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    import numpy as np
+
+    from distributeddeeplearning_tpu.train import loop
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    cfg = TrainConfig(
+        model="gpt_tiny", global_batch_size=8, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(dataset="causal", seq_len=32, vocab_size=512))
+    summary = loop.run(cfg, total_steps=2, eval_batches=2,
+                       logger=MetricLogger(enabled=False))
+    assert np.isfinite(summary["eval_loss"])
+    assert summary["eval_ppl"] > 1.0

@@ -65,7 +65,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="steps excluded from throughput timing")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--eval-batches", type=int, default=0,
-                   help="run sharded top-1 eval over N batches after training")
+                   help="periodic + final held-out eval over N batches "
+                        "(top-1 for image models, loss/perplexity for "
+                        "token models)")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore existing checkpoints in --checkpoint-dir")
     p.add_argument("--profile-steps", default=None, metavar="A,B",

@@ -59,6 +59,17 @@ def _registry() -> dict[str, ModelSpec]:
         "gpt_tiny": ModelSpec(
             name="gpt_tiny", build=gpt.tiny_gpt, input_kind="tokens",
             param_count=0, objective="causal"),
+        # GPT-2 124M as a 4-stage GPipe pipeline over the `pipeline` axis.
+        "gpt2_small_pp": ModelSpec(
+            name="gpt2_small_pp", objective="causal",
+            build=lambda **kw: gpt.gpt2_small(
+                pipeline_stages=4, pipeline_microbatches=8, **kw),
+            input_kind="tokens", param_count=0),
+        "gpt_tiny_pp": ModelSpec(
+            name="gpt_tiny_pp", objective="causal",
+            build=lambda **kw: gpt.tiny_gpt(
+                pipeline_stages=2, pipeline_microbatches=4, **kw),
+            input_kind="tokens", param_count=0),
         # BERT-base with a top-1-routed 8-expert MoE FFN every other layer
         # (models/moe.py), expert-parallel over the `expert` mesh axis.
         "bert_base_moe": ModelSpec(

@@ -202,25 +202,19 @@ class BertMLM(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if cfg.pipeline_stages > 1:
+            import functools
+
             from distributeddeeplearning_tpu.models.pipeline import (
-                PipelinedEncoder)
-            if cfg.num_layers % cfg.pipeline_stages:
-                raise ValueError(
-                    f"num_layers={cfg.num_layers} not divisible by "
-                    f"pipeline_stages={cfg.pipeline_stages}")
+                build_pipelined)
             if cfg.num_experts > 0:
                 raise ValueError(
                     "pipeline_stages > 1 requires homogeneous layers; "
                     "disable MoE (num_experts=0)")
-            import functools
-            x = PipelinedEncoder(
-                layer_factory=functools.partial(
-                    EncoderLayer, cfg, self.dtype),
-                num_stages=cfg.pipeline_stages,
-                layers_per_stage=cfg.num_layers // cfg.pipeline_stages,
+            x = build_pipelined(
+                functools.partial(EncoderLayer, cfg, self.dtype),
+                num_layers=cfg.num_layers, num_stages=cfg.pipeline_stages,
                 num_microbatches=cfg.pipeline_microbatches,
-                remat=cfg.remat,
-                dtype=self.dtype, name="pipeline")(
+                remat=cfg.remat, dtype=self.dtype)(
                     x, attention_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         else:

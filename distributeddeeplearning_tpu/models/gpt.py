@@ -36,6 +36,10 @@ class GptConfig:
     attention_impl: str = "dense"   # dense | flash (causal Pallas kernel) |
                                     # ring (causal ring over the `seq` axis)
     remat: bool = False
+    # GPipe pipeline over the `pipeline` mesh axis (models/pipeline.py);
+    # num_layers must divide evenly into stages.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
     @property
     def intermediate_size(self) -> int:
@@ -163,15 +167,29 @@ class GptLM(nn.Module):
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
-        for i in range(cfg.num_layers):
-            block = DecoderBlock(cfg, self.dtype, name=f"layer{i}")
-            if cfg.remat:
-                x = nn.remat(
-                    lambda mdl, h, m: mdl(h, m, deterministic=deterministic))(
-                    block, x, pad_mask)
-            else:
-                x = block(x, pad_mask, deterministic=deterministic)
+        if cfg.pipeline_stages > 1:
+            import functools
+
+            from distributeddeeplearning_tpu.models.pipeline import (
+                build_pipelined)
+            x = build_pipelined(
+                functools.partial(DecoderBlock, cfg, self.dtype),
+                num_layers=cfg.num_layers, num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches,
+                remat=cfg.remat, dtype=self.dtype)(
+                    x, pad_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        else:
+            for i in range(cfg.num_layers):
+                block = DecoderBlock(cfg, self.dtype, name=f"layer{i}")
+                if cfg.remat:
+                    x = nn.remat(
+                        lambda mdl, h, m: mdl(
+                            h, m, deterministic=deterministic))(
+                        block, x, pad_mask)
+                else:
+                    x = block(x, pad_mask, deterministic=deterministic)
+                x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)

@@ -32,6 +32,23 @@ import jax.numpy as jnp
 Dtype = Any
 
 
+def build_pipelined(layer_factory, *, num_layers: int, num_stages: int,
+                    num_microbatches: int, remat: bool = False,
+                    dtype: Dtype = jnp.bfloat16,
+                    name: str = "pipeline") -> "PipelinedEncoder":
+    """Shared model-side wiring (BERT and GPT use identical logic): validate
+    the stage split and construct the pipelined encoder."""
+    if num_layers % num_stages:
+        raise ValueError(
+            f"num_layers={num_layers} not divisible by "
+            f"pipeline_stages={num_stages}")
+    return PipelinedEncoder(
+        layer_factory=layer_factory, num_stages=num_stages,
+        layers_per_stage=num_layers // num_stages,
+        num_microbatches=num_microbatches, remat=remat, dtype=dtype,
+        name=name)
+
+
 class _LayerStep(nn.Module):
     """scan body: carry=(x, mask) -> one encoder layer applied."""
 

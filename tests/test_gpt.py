@@ -121,3 +121,21 @@ def test_gpt_runs_via_loop(devices8):
     summary = loop.run(cfg, total_steps=2, logger=MetricLogger(enabled=False))
     assert summary["final_step"] == 2
     assert np.isfinite(summary["final_metrics"]["loss"])
+
+
+def test_gpt_pipeline_trains(devices8):
+    """GPT over pp x dp x tp: the GPipe schedule serves decoder blocks too."""
+    from distributeddeeplearning_tpu.train import loop
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    cfg = TrainConfig(
+        model="gpt_tiny_pp", global_batch_size=8, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(pipeline=2, data=2, model=2),
+        data=DataConfig(dataset="causal", seq_len=32, vocab_size=512),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3,
+                                  reference_batch=8,
+                                  schedule="linear", label_smoothing=0.0))
+    summary = loop.run(cfg, total_steps=3, logger=MetricLogger(enabled=False))
+    assert summary["final_step"] == 3
+    assert np.isfinite(summary["final_metrics"]["loss"])

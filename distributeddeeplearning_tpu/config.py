@@ -103,6 +103,11 @@ class TrainConfig:
     dtype: str = "bfloat16"       # compute dtype; params stay f32
     grad_accum_steps: int = 1     # microbatches per optimizer step (config 5
                                   # at 32k runs on any mesh via accumulation)
+    steps_per_loop: int = 1       # train steps fused into ONE XLA program
+                                  # (lax.scan) when data is generated
+                                  # on-device; amortizes per-step host
+                                  # dispatch — the TPUEstimator
+                                  # iterations_per_loop idiom
     seed: int = 0
     log_every: int = 100
     eval_every_epochs: float = 1.0

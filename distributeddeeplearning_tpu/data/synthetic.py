@@ -31,6 +31,10 @@ class _SyntheticSource:
     def __init__(self, generator: Callable, seed: int,
                  sharding: Optional[jax.sharding.Sharding]):
         self.seed = seed
+        # Raw (untraced) generator: the fused multi-step train loop
+        # (steps.make_fused_train_loop) inlines batch generation into the
+        # scanned step program, so K steps need zero host dispatches.
+        self.gen_fn = generator
         self._gen = jax.jit(generator, out_shardings=sharding)
 
     def batch(self, step: int) -> dict:

@@ -237,17 +237,57 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             if spe is not None:
                 eval_every_steps = max(int(config.eval_every_epochs * spe), 1)
 
+    # Fused multi-step blocks (config.steps_per_loop > 1): only when batches
+    # are generated on-device (synthetic sources expose gen_fn) — a streaming
+    # host pipeline needs a dispatch per step anyway. Blocks are split at
+    # every step where host-side action fires (logging, checkpoint, eval,
+    # warmup timer, profiling span edges, fault injection), so cadence
+    # semantics are identical to the per-step path.
+    fused_runner = None
+    if config.steps_per_loop > 1 and source is not None:
+        fused_runner = steps.make_fused_train_loop(
+            train_step, source, batch_shd, mesh)
+        if fused_runner is None and jax.process_index() == 0:
+            print(f"# warning: steps_per_loop={config.steps_per_loop} ignored "
+                  f"— loader {resolved_loader!r} streams from the host, so "
+                  f"each step needs its own dispatch (fusion requires an "
+                  f"on-device synthetic source)", file=sys.stderr, flush=True)
+
+    def _next_boundary(pos: int) -> int:
+        """Smallest action step (in completed-steps space) > pos."""
+        cands = [total_steps]
+        cadences = [config.log_every]
+        if eval_every_steps:
+            cadences.append(eval_every_steps)
+        if ckpt is not None:
+            cadences.append(config.checkpoint_every_steps)
+        for c in cadences:
+            if c > 0:
+                cands.append((pos // c + 1) * c)
+        points = [start_step + warmup_steps, config.fail_at_step]
+        if config.profile_steps is not None:
+            points.extend(config.profile_steps)
+        cands.extend(a for a in points if a is not None and a > pos)
+        return min(c for c in cands if c > pos)
+
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
     # warmup_steps == 0 means "time everything" (incl. compile).
     t_timed = time.perf_counter() if warmup_steps == 0 else None
     try:
-        for i in range(start_step, total_steps):
+        i = start_step  # steps completed so far
+        while i < total_steps:
+            n = (min(config.steps_per_loop, _next_boundary(i) - i)
+                 if fused_runner is not None else 1)
             profile.before_step(i)
-            state, metrics = train_step(state, source.batch(i), rng)
-            profile.after_step(i, metrics)
-            done = i - start_step + 1
+            if n == 1:
+                state, metrics = train_step(state, source.batch(i), rng)
+            else:
+                state, metrics = fused_runner(state, rng, i, n)
+            i += n
+            profile.after_step(i - 1, metrics)
+            done = i - start_step
             if done == warmup_steps:
                 # device_get, not block_until_ready: a fetch is a true
                 # execution barrier on every backend (remote-tunneled devices
@@ -255,34 +295,36 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 # flight, which would start the timing window early).
                 jax.device_get(metrics)
                 t_timed = time.perf_counter()
-            if (i + 1) % config.log_every == 0 or i + 1 == total_steps:
+            if i % config.log_every == 0 or i == total_steps:
                 # logger floats every metric (a true fetch barrier); no
                 # separate block needed.
-                logger.log(int(i + 1), metrics,
+                logger.log(int(i), metrics,
                            examples_per_step=config.global_batch_size,
-                           lr=float(sched(i)))
+                           lr=float(sched(i - 1)))
             if done > warmup_steps:
-                timed_examples += config.global_batch_size
+                # Blocks never straddle the warmup edge (it is a boundary),
+                # so the whole block counts toward the timed window.
+                timed_examples += config.global_batch_size * n
             if ckpt is not None:
-                ckpt.maybe_save(i + 1, state)
-            if (eval_every_steps and (i + 1) % eval_every_steps == 0
-                    and i + 1 < total_steps):
+                ckpt.maybe_save(i, state)
+            if (eval_every_steps and i % eval_every_steps == 0
+                    and i < total_steps):
                 t_eval = time.perf_counter()
                 val = evaluator(state)
-                evals.append((i + 1, val))
-                logger.log(int(i + 1), {evaluator.metric_name: val})
+                evals.append((i, val))
+                logger.log(int(i), {evaluator.metric_name: val})
                 if t_timed is not None:
                     # Keep throughput numbers about training: shift the
                     # timing origin past the eval pause.
                     t_timed += time.perf_counter() - t_eval
-            if config.fail_at_step is not None and i + 1 == config.fail_at_step:
+            if config.fail_at_step is not None and i == config.fail_at_step:
                 # Fault injection (SURVEY.md §5.3): die like a preempted host
                 # so the launcher's fail-whole path + checkpoint-resume get
                 # exercised end-to-end.
                 if ckpt is not None:
                     ckpt.wait()
                 raise SystemExit(
-                    f"fault injection: killed after step {i + 1}")
+                    f"fault injection: killed after step {i}")
         # End-of-run sync: fetching the final step's metrics and step counter
         # is a true completion barrier for the whole dispatch queue (the last
         # program's outputs exist only after it ran), without a per-leaf

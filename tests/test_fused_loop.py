@@ -1,0 +1,156 @@
+"""Fused multi-step train loop (config.steps_per_loop): K steps + on-device
+batch generation compiled into one XLA program (lax.scan) must match K
+per-step applications from the same state, and every host-side cadence
+(logging, eval, checkpoint, fault injection) must fire at the same steps.
+
+Equivalence is asserted from a SHARED starting state over one block with a
+BatchNorm-free model: the two paths are the same math but different XLA
+programs, so fp reassociation (~1e-6/step) is expected — and BN+ReLU
+training on random data amplifies it chaotically, which would swamp any
+end-to-end trajectory comparison (observed empirically: 8e-7 param diff
+grows to 1e-2 within one ResNet step)."""
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.config import (
+    DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.data import synthetic
+from distributeddeeplearning_tpu.parallel import mesh as meshlib
+from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.train import loop, optim, steps
+from distributeddeeplearning_tpu.train.state import TrainState
+
+
+class _TinyNet(nn.Module):
+    """BN-free classifier: no cross-example normalization, so the only
+    fused-vs-per-step difference is benign fp reassociation."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(10)(x)
+
+
+def _cnn_cfg(**kw):
+    base = dict(
+        model="resnet18", global_batch_size=16, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(synthetic=True, image_size=16, num_classes=10),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.01,
+                                  reference_batch=16, schedule="constant",
+                                  warmup_epochs=0.0))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _assert_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(x, y, rtol=rtol, atol=atol),
+        jax.device_get(a), jax.device_get(b))
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_block_matches_per_step_dp():
+    cfg = _cnn_cfg(global_batch_size=32,
+                   data=DataConfig(synthetic=True, image_size=8,
+                                   num_classes=10))
+    mesh = meshlib.make_mesh(cfg.parallel)
+    batch_shd = shardlib.batch_sharding(mesh)
+    model = _TinyNet()
+    tx, _ = optim.make_optimizer(cfg.optimizer, cfg.global_batch_size, 10,
+                                 None)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8, 8, 3)), train=False)
+
+    def fresh_state():
+        params = jax.tree_util.tree_map(jnp.array, variables["params"])
+        return TrainState.create(params=params, opt_state=tx.init(params),
+                                 batch_stats=None)
+
+    src = synthetic.make_source(cfg, "image", sharding=batch_shd)
+    step = steps.make_dp_train_step(model, tx, mesh, cfg, "image")
+    fused = steps.make_fused_train_loop(step, src, batch_shd, mesh)
+    assert fused is not None
+    rng = jax.random.key(1)
+
+    s_ref = fresh_state()
+    for i in range(4):
+        s_ref, m_ref = step(s_ref, src.batch(i), rng)
+    s_fused, m_fused = fused(fresh_state(), rng, 0, 4)
+
+    assert int(s_fused.step) == 4
+    _assert_close(s_ref.params, s_fused.params)
+    np.testing.assert_allclose(float(m_ref["loss"]), float(m_fused["loss"]),
+                               rtol=1e-5)
+    # A second block reuses the n=4 executable at a different start offset.
+    s_ref2, _ = step(s_ref, src.batch(4), rng)
+    for i in range(5, 8):
+        s_ref2, _ = step(s_ref2, src.batch(i), rng)
+    s_fused2, _ = fused(s_fused, rng, 4, 4)
+    _assert_close(s_ref2.params, s_fused2.params)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_matches_per_step_gspmd():
+    # LayerNorm (continuous) instead of BN: the loop.run trajectories stay
+    # comparable over a few steps under AdamW's small lr.
+    def run(spl):
+        cfg = TrainConfig(
+            model="bert_tiny", global_batch_size=8, dtype="float32",
+            log_every=10**9, steps_per_loop=spl,
+            parallel=ParallelConfig(data=2, seq=2, model=2),
+            data=DataConfig(dataset="mlm", seq_len=32, vocab_size=128),
+            optimizer=OptimizerConfig(name="adamw", learning_rate=1e-4,
+                                      schedule="linear", label_smoothing=0.0))
+        summary = loop.run(cfg, total_steps=5, return_state=True)
+        assert summary["final_step"] == 5
+        return summary
+
+    s1, s3 = run(1), run(3)
+    _assert_close(s1["state"].params, s3["state"].params,
+                  rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_respects_eval_cadence():
+    # steps_per_epoch=3, eval every epoch -> evals at step 3 and final at 6,
+    # even though steps_per_loop=4 would otherwise stride past step 3.
+    cfg = _cnn_cfg(steps_per_loop=4, steps_per_epoch=3, eval_every_epochs=1.0)
+    summary = loop.run(cfg, total_steps=6, eval_batches=1)
+    assert [step for step, _ in summary["evals"]] == [3, 6]
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_respects_fail_at_step():
+    cfg = _cnn_cfg(steps_per_loop=4, fail_at_step=3)
+    with pytest.raises(SystemExit, match="after step 3"):
+        loop.run(cfg, total_steps=6)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_checkpoint_resume(tmp_path):
+    # Crash at step 3 under fused blocks, resume, finish; the resumed run
+    # must restart from the step-3 checkpoint and complete.
+    cfg = _cnn_cfg(steps_per_loop=2, checkpoint_dir=str(tmp_path),
+                   checkpoint_every_steps=3, fail_at_step=3)
+    with pytest.raises(SystemExit):
+        loop.run(cfg, total_steps=6)
+    resumed = loop.run(cfg.replace(fail_at_step=None), total_steps=6)
+    assert resumed["start_step"] == 3
+    assert resumed["final_step"] == 6
+    assert np.isfinite(resumed["final_metrics"]["loss"])
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_throughput_fields():
+    summary = loop.run(_cnn_cfg(steps_per_loop=3), total_steps=7,
+                       warmup_steps=1)
+    assert summary["examples_per_sec"] > 0
+    assert summary["steps_per_sec"] > 0

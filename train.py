@@ -41,6 +41,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--accum", type=int, default=None,
                    help="gradient-accumulation microbatches per optimizer "
                         "step (config 5's batch=32k on small meshes)")
+    p.add_argument("--steps-per-loop", type=int, default=None,
+                   help="fuse N train steps into one XLA program (lax.scan) "
+                        "when data is generated on-device — amortizes "
+                        "per-step host dispatch latency")
     p.add_argument("--fsdp", type=int, default=None)
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=None, help="sequence-parallel size")
@@ -118,6 +122,11 @@ def build_config(args: argparse.Namespace):
         if args.accum <= 0:
             raise SystemExit(f"--accum must be positive (got {args.accum})")
         cfg = cfg.replace(grad_accum_steps=args.accum)
+    if args.steps_per_loop is not None:
+        if args.steps_per_loop <= 0:
+            raise SystemExit(
+                f"--steps-per-loop must be positive (got {args.steps_per_loop})")
+        cfg = cfg.replace(steps_per_loop=args.steps_per_loop)
     cfg = cfg.replace(backend=args.backend)
     if args.profile_steps:
         try:

@@ -28,7 +28,8 @@ class ModelSpec:
 
 
 def _registry() -> dict[str, ModelSpec]:
-    from distributeddeeplearning_tpu.models import bert, densenet, gpt, resnet
+    from distributeddeeplearning_tpu.models import (bert, densenet, gpt,
+                                                    resnet, vit)
 
     def img(build, name, params):
         return ModelSpec(name=name, build=build, input_kind="image",
@@ -42,6 +43,12 @@ def _registry() -> dict[str, ModelSpec]:
         "resnet152": img(resnet.resnet152, "resnet152", 60_192_808),
         "densenet121": img(densenet.densenet121, "densenet121", 7_978_856),
         "densenet169": img(densenet.densenet169, "densenet169", 14_149_480),
+        # Vision transformers (beyond reference scope): the MXU-friendliest
+        # image models — all matmuls, no BatchNorm bandwidth tax. Param
+        # counts match timm vit_{base,large}_patch16_224 at 224px init.
+        "vit_b16": img(vit.vit_b16, "vit_b16", 86_567_656),
+        "vit_l16": img(vit.vit_l16, "vit_l16", 304_326_632),
+        "vit_tiny": img(vit.tiny_vit, "vit_tiny", 0),
         "bert_base": ModelSpec(
             name="bert_base", build=bert.bert_base_mlm, input_kind="tokens",
             param_count=109_514_298, objective="mlm"),

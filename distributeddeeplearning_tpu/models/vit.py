@@ -1,0 +1,161 @@
+"""Vision Transformer (ViT-B/16, ViT-L/16) in Flax, TPU-first.
+
+Beyond the reference's CNN-era zoo (SURVEY.md §2 #4 lists ResNet/DenseNet/
+BERT): ViT is the MXU-friendliest image model — the whole network is large
+matmuls over a 197-token sequence, no BatchNorm bandwidth tax (the measured
+ResNet50 bottleneck, BASELINE.md). Canonical pre-LN blocks; parameter counts
+match timm's ``vit_{base,large}_patch16_224`` exactly (86,567,656 /
+304,326,632 — asserted in tests/test_models.py).
+
+Reuses the sharding-annotated ``SelfAttention`` from models/bert.py, so
+tensor-parallel (``model`` axis) and flash-attention configs work unchanged;
+with image inputs the trainer picks the explicit-DP shard_map path unless
+tp/fsdp axes are requested (train/loop.py::uses_gspmd).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import bert
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    dropout_rate: float = 0.0     # DeiT-style default; ViT-paper used 0.1
+    layer_norm_eps: float = 1e-6
+    attention_impl: str = "dense"  # 197 tokens: dense scores are cheap
+    remat: bool = False
+
+    def as_bert_cfg(self) -> bert.BertConfig:
+        """The attention-relevant slice, for reusing bert.SelfAttention."""
+        return bert.BertConfig(
+            hidden_size=self.hidden_size, num_heads=self.num_heads,
+            dropout_rate=self.dropout_rate,
+            attention_impl=self.attention_impl)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    cfg: ViTConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool):
+        cfg = self.cfg
+        y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="attention_ln")(x)
+        y = bert.SelfAttention(cfg.as_bert_cfg(), self.dtype,
+                               name="attention")(
+            y, None, deterministic=deterministic)
+        y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_ln")(x)
+        y = bert._dense(cfg.intermediate_size, ("embed", "mlp"),
+                        "intermediate", self.dtype)(y)
+        y = nn.gelu(y, approximate=False)
+        y = bert._dense(cfg.hidden_size, ("mlp", "embed"), "mlp_output",
+                        self.dtype)(y)
+        y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class VisionTransformer(nn.Module):
+    """NHWC image in, (B, num_classes) f32 logits out.
+
+    The position table is sized at init from the example input's patch grid
+    (224 -> 14x14+cls = 197), so test-sized inputs init small without a
+    resize path.
+    """
+
+    cfg: ViTConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        cfg = self.cfg
+        deterministic = not train
+        x = jnp.asarray(x, self.dtype)
+        p = cfg.patch_size
+        x = nn.Conv(cfg.hidden_size, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.xavier_uniform(),
+                        (None, None, None, "embed")),
+                    name="patch_embed")(x)
+        b, h, w, d = x.shape
+        x = x.reshape(b, h * w, d)
+
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         (None, "embed")),
+            (1, cfg.hidden_size), jnp.float32)
+        pos = self.param(
+            "pos_embedding",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         (None, "embed")),
+            (h * w + 1, cfg.hidden_size), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, d)), x], axis=1)
+        x = x + pos[None].astype(self.dtype)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        for i in range(cfg.num_layers):
+            block = ViTBlock(cfg, self.dtype, name=f"block{i}")
+            if cfg.remat:
+                # Same function-lift as models/bert.py: `deterministic` stays
+                # a closed-over Python bool.
+                x = nn.remat(lambda mdl, hdn: mdl(
+                    hdn, deterministic=deterministic))(block, x)
+            else:
+                x = block(x, deterministic=deterministic)
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="final_ln")(x)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", None)),
+            name="classifier")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+def vit_b16(num_classes: int = 1000, dtype: Dtype = jnp.bfloat16,
+            **overrides: Any) -> VisionTransformer:
+    return VisionTransformer(
+        ViTConfig(num_classes=num_classes, **overrides), dtype=dtype)
+
+
+def vit_l16(num_classes: int = 1000, dtype: Dtype = jnp.bfloat16,
+            **overrides: Any) -> VisionTransformer:
+    return VisionTransformer(
+        ViTConfig(num_classes=num_classes, hidden_size=1024, num_layers=24,
+                  num_heads=16, intermediate_size=4096, **overrides),
+        dtype=dtype)
+
+
+def tiny_vit(num_classes: int = 10, dtype: Dtype = jnp.float32,
+             **overrides: Any) -> VisionTransformer:
+    """Test-sized ViT (8px patches on small test images)."""
+    return VisionTransformer(
+        ViTConfig(num_classes=num_classes, patch_size=8, hidden_size=64,
+                  num_layers=2, num_heads=4, intermediate_size=128,
+                  **overrides),
+        dtype=dtype)

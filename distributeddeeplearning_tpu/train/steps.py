@@ -49,12 +49,15 @@ def _image_loss_fn(model, config: TrainConfig):
     smoothing = config.optimizer.label_smoothing
 
     def loss_fn(params, batch_stats, batch, rng):
-        del rng  # CNNs here have no dropout
         variables = {"params": params}
         if batch_stats is not None:
             variables["batch_stats"] = batch_stats
+        # rngs is harmless for dropout-free CNNs and required for image
+        # transformers (models/vit.py); per-shard/per-step folding happens in
+        # the calling step fn.
         out, mutated = model.apply(
-            variables, batch["image"], train=True, mutable=["batch_stats"])
+            variables, batch["image"], train=True, mutable=["batch_stats"],
+            rngs={"dropout": rng})
         loss = losses.smoothed_softmax_ce(out, batch["label"], smoothing)
         metrics = {"loss": loss,
                    "accuracy": losses.top1_accuracy(out, batch["label"])}

@@ -35,6 +35,7 @@ def abstract_init(name: str):
 @pytest.mark.parametrize("name", [
     "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "densenet121", "densenet169", "bert_base", "bert_large",
+    "vit_b16", "vit_l16",
 ])
 def test_param_counts(name):
     spec = models.model_spec(name)
@@ -70,6 +71,41 @@ def test_bert_tiny_forward_shape():
     logits = model.apply(variables, ids, train=False)
     assert logits.shape == (2, 16, 1024)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_vit_tiny_forward_and_train_smoke():
+    """Forward shape + a DP train step: exercises the dropout-rng plumbing
+    the image loss fn threads through for transformer image models."""
+    model = models.get_model("vit_tiny", dtype=jnp.float32, num_classes=10,
+                             dropout_rate=0.1)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = model.init({"params": jax.random.key(1)}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert bool(jnp.isfinite(logits).all())
+    # train=True requires the dropout rng (dropout_rate > 0).
+    out = model.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.key(2)})
+    assert bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.usefixtures("devices8")
+def test_vit_trains_in_loop():
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = TrainConfig(
+        model="vit_tiny", global_batch_size=16, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(synthetic=True, image_size=16, num_classes=10),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-3,
+                                  schedule="constant", warmup_epochs=0.0,
+                                  label_smoothing=0.0))
+    summary = loop.run(cfg, total_steps=2)
+    assert summary["final_step"] == 2
+    assert np.isfinite(summary["final_metrics"]["loss"])
 
 
 def test_bn_stats_update():

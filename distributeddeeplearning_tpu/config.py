@@ -54,7 +54,8 @@ class DataConfig:
     synthetic: bool = True        # config 1: "synthetic data" BASELINE.json:7
     synthetic_learnable: bool = False  # embed a class signal in synthetic
                                   # images (top-1 becomes meaningful)
-    loader: str = "auto"          # auto | tf | native (csrc/ C++ loader)
+    loader: str = "auto"          # auto | tf | native (csrc/ C++ loader) |
+                                  # grain (data/grain_pipeline.py)
     image_size: int = 224
     num_classes: int = 1000
     shuffle_buffer: int = 16384

@@ -22,12 +22,14 @@ from distributeddeeplearning_tpu.data.synthetic import (  # noqa: F401
 def resolve_loader(config: TrainConfig, input_kind: str) -> str:
     """Resolve ``config.data.loader`` to the concrete pipeline that will run.
 
-    Returns one of ``synthetic | tokens | tf | native``. ``auto`` resolution
-    is environment-dependent (C++ toolchain, DDL_NATIVE_LOADER) and the tf /
-    native pipelines shuffle differently, so the resolved value is part of a
-    run's determinism contract: the loop logs it at startup and persists it
-    in checkpoint metadata so a resume under a different resolution fails
+    Returns one of ``synthetic | tokens | tf | native | grain``. ``auto``
+    resolution is environment-dependent (C++ toolchain, DDL_NATIVE_LOADER)
+    and the pipelines shuffle differently, so the resolved value is part of
+    a run's determinism contract: the loop logs it at startup and persists
+    it in checkpoint metadata so a resume under a different resolution fails
     loudly instead of silently switching sample streams (ADVICE r1 #1).
+    ``grain`` (data/grain_pipeline.py) is explicit-only: ``auto`` keeps the
+    C++ loader for folders and tf.data for TFRecords.
     """
     d = config.data
     if d.synthetic or not d.data_dir:
@@ -70,6 +72,16 @@ def make_source(config: TrainConfig, input_kind: str,
         from distributeddeeplearning_tpu.data import native
         return native.make_native_source(
             config, sharding, train=train, start_step=start_step)
-    from distributeddeeplearning_tpu.data import imagenet
-    return imagenet.make_imagenet_source(
-        config, sharding, train=train, start_step=start_step)
+    if loader == "grain":
+        from distributeddeeplearning_tpu.data import grain_pipeline
+        return grain_pipeline.make_grain_source(
+            config, sharding, train=train, start_step=start_step)
+    if loader == "tf":
+        from distributeddeeplearning_tpu.data import imagenet
+        return imagenet.make_imagenet_source(
+            config, sharding, train=train, start_step=start_step)
+    # Loud failure beats a silent sample-stream switch (the determinism
+    # contract resolve_loader documents).
+    raise ValueError(
+        f"unknown data loader {loader!r}; expected one of "
+        f"auto | tf | native | grain")

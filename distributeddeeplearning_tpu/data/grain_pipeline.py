@@ -1,0 +1,171 @@
+"""grain-native ImageNet pipeline — the grain half of BASELINE.json:5
+("CUDA/DALI data loaders → grain / tf.data pipelines with device-side HBM
+prefetch"), alongside tf.data (data/imagenet.py) and the in-tree C++ loader
+(data/native.py).
+
+Why grain fits TPU hosts: the whole pipeline is a deterministic index
+transform (``MapDataset``) — shard, shuffle, repeat, skip are all O(1)
+index arithmetic, so per-process sharding is exact, every epoch reshuffles
+deterministically from the seed, and **resume is a slice**: skipping
+``start_step`` batches costs nothing (no decode of skipped records), unlike
+stream-skip loaders. Decode/augment runs in grain's prefetch threads (PIL
+releases the GIL during JPEG work); records land in the same
+``StreamSource`` HBM path as the other loaders.
+
+Supports the image-folder layout (``<split>/<wnid>/*.JPEG``). TFRecords
+stay on tf.data — grain reads ArrayRecord, not TFRecord, natively.
+
+The augmentation recipe matches data/imagenet.py exactly (random-resized
+crop 8-100% area / 3-4 aspect, flip, mean/std normalize; eval
+center-crop-with-padding protocol) — the details that silently cost top-1
+if mismatched (SURVEY.md §7). Train decodes at full resolution (the
+ADVICE r1 crop-quality rule: DCT-scaled decode only for eval's fixed
+center crop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+from distributeddeeplearning_tpu.data.imagenet import (
+    CROP_PADDING, MEAN_RGB, STDDEV_RGB, StreamSource, _per_process_batch,
+    folder_index)
+
+
+class ImageFolderSource:
+    """grain RandomAccessDataSource over an indexed image-folder split."""
+
+    def __init__(self, paths: list[str], labels: list[int]):
+        self._paths = paths
+        self._labels = np.asarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return len(self._paths)
+
+    def __getitem__(self, i: int) -> dict:
+        with open(self._paths[i], "rb") as f:
+            return {"bytes": f.read(), "label": self._labels[i]}
+
+
+def _random_crop_box(rng: np.random.Generator, width: int, height: int,
+                     attempts: int = 10) -> tuple[int, int, int, int]:
+    """Sample an 8-100%-area, 3/4-4/3-aspect crop (x, y, w, h) — the
+    tf.image.sample_distorted_bounding_box recipe in numpy."""
+    area = width * height
+    for _ in range(attempts):
+        target_area = area * rng.uniform(0.08, 1.0)
+        aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        w = int(round(np.sqrt(target_area * aspect)))
+        h = int(round(np.sqrt(target_area / aspect)))
+        if 0 < w <= width and 0 < h <= height:
+            x = int(rng.integers(0, width - w + 1))
+            y = int(rng.integers(0, height - h + 1))
+            return x, y, w, h
+    # Fallback: central max-square (same as tf's use_image_if_no_bounding_boxes
+    # degenerate path).
+    side = min(width, height)
+    return (width - side) // 2, (height - side) // 2, side, side
+
+
+@dataclasses.dataclass
+class DecodeAndAugment:
+    """Per-record decode + augment, run under grain's per-record RNG
+    (grain.python.RandomMapTransform protocol via __call__(record, rng))."""
+
+    image_size: int
+    train: bool
+    dtype: Any
+
+    def __call__(self, record: dict, rng: np.random.Generator) -> dict:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(record["bytes"]))
+        size = self.image_size
+        if self.train:
+            img = img.convert("RGB")
+            x, y, w, h = _random_crop_box(rng, img.width, img.height)
+            img = img.crop((x, y, x + w, y + h)).resize(
+                (size, size), Image.BILINEAR)
+            arr = np.asarray(img, np.float32)
+            if rng.random() < 0.5:
+                arr = arr[:, ::-1]
+        else:
+            # DCT-scaled decode is safe for the fixed center crop (eval only);
+            # draft() keeps both sides >= the padded frame.
+            img.draft("RGB", (size + CROP_PADDING, size + CROP_PADDING))
+            img = img.convert("RGB")
+            ratio = size / (size + CROP_PADDING)
+            crop = min(int(ratio * min(img.width, img.height)),
+                       min(img.width, img.height))
+            x = (img.width - crop) // 2
+            y = (img.height - crop) // 2
+            img = img.crop((x, y, x + crop, y + crop)).resize(
+                (size, size), Image.BILINEAR)
+            arr = np.asarray(img, np.float32)
+        arr = (arr - np.asarray(MEAN_RGB, np.float32)) / np.asarray(
+            STDDEV_RGB, np.float32)
+        return {"image": arr.astype(self.dtype),
+                "label": record["label"]}
+
+
+def _np_dtype(config: TrainConfig):
+    if config.dtype == "bfloat16":
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    return np.float32
+
+
+def build_grain_dataset(config: TrainConfig, *, train: bool,
+                        process_index: Optional[int] = None,
+                        process_count: Optional[int] = None,
+                        start_step: int = 0):
+    """Per-process grain IterDataset of host batches (dict of np arrays)."""
+    import grain.python as grain
+    import jax
+
+    d: DataConfig = config.data
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    per_process = _per_process_batch(config, process_count)
+
+    paths, labels = folder_index(d.data_dir, "train" if train else "val")
+    ds = grain.MapDataset.source(ImageFolderSource(paths, labels))
+    ds = ds.seed(config.seed)
+    # Per-process shard: exact index interleave (record i -> process i % N),
+    # the role tf.data's shard() / Horovod's rank-sharding played.
+    ds = ds.slice(slice(process_index, None, process_count))
+    if train:
+        # shuffle-then-repeat: each epoch reshuffles deterministically
+        # (reseed_each_epoch), matching the tf path's seeded shuffle.
+        ds = ds.shuffle(seed=config.seed).repeat(None)
+        if start_step:
+            # Resume = index arithmetic; skipped records are never decoded.
+            ds = ds.slice(slice(start_step * per_process, None))
+    ds = ds.random_map(DecodeAndAugment(d.image_size, train,
+                                        _np_dtype(config)))
+    threads = max(os.cpu_count() or 8, 8)
+    # Batch AFTER to_iter_dataset: prefetch threads then parallelize and
+    # buffer individual decoded records (prefetch_buffer_size counts
+    # elements — batching first would make it count whole batches and the
+    # buffer could grow to GBs of decoded images on a fast host).
+    ds = ds.to_iter_dataset(grain.ReadOptions(
+        num_threads=threads,
+        prefetch_buffer_size=max(2 * per_process, 64)))
+    return ds.batch(per_process, drop_remainder=True)
+
+
+def make_grain_source(config: TrainConfig, sharding, *, train: bool = True,
+                      start_step: int = 0) -> StreamSource:
+    ds = build_grain_dataset(config, train=train,
+                             start_step=start_step if train else 0)
+    return StreamSource(iter(ds), sharding, first_step=start_step,
+                        depth=config.data.prefetch_depth)

@@ -68,21 +68,16 @@ def build(config: TrainConfig, total_steps: int):
     if spec.input_kind == "tokens":
         kw: dict = dict(vocab_size=config.data.vocab_size, dtype=dtype,
                         seq_len=config.data.seq_len)
-        if config.attention_impl:
-            kw["attention_impl"] = config.attention_impl
-        if config.remat:
-            kw["remat"] = True
-        model = spec.build(**kw)
     else:
         kw = dict(num_classes=config.data.num_classes, dtype=dtype)
-        # Transformer image models (ViT) take the same attention/remat knobs
-        # as token models; CNN builders reject them loudly (TypeError names
-        # the kwarg) rather than silently ignoring the flag.
-        if config.attention_impl:
-            kw["attention_impl"] = config.attention_impl
-        if config.remat:
-            kw["remat"] = True
-        model = spec.build(**kw)
+    # Attention/remat knobs apply to any transformer (BERT/GPT/ViT); CNN
+    # builders reject them loudly (TypeError names the kwarg) rather than
+    # silently ignoring the flag.
+    if config.attention_impl:
+        kw["attention_impl"] = config.attention_impl
+    if config.remat:
+        kw["remat"] = True
+    model = spec.build(**kw)
 
     # A mesh axis nothing maps onto silently duplicates compute across its
     # groups (devices wasted, no error from XLA) — reject up front, like the

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
-"""Host input-pipeline throughput: native C++ loader vs tf.data.
+"""Host input-pipeline throughput: native C++ loader vs tf.data vs grain.
 
 The reference fed GPUs from DALI/tf.data native workers; this measures our
-two equivalents end-to-end (JPEG decode + ResNet augmentation + batch
+equivalents end-to-end (JPEG decode + ResNet augmentation + batch
 assembly -> host float32 NHWC) on a synthetic image-folder corpus, so the
 "does the host keep the chips fed" question has a number.
 
@@ -75,6 +75,25 @@ def bench_tf(data_dir: str, batch: int, size: int, batches: int) -> float:
     return batch * batches / (time.perf_counter() - t0)
 
 
+def bench_grain(data_dir: str, batch: int, size: int, batches: int) -> float:
+    from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+    from distributeddeeplearning_tpu.data import grain_pipeline
+
+    cfg = TrainConfig(
+        global_batch_size=batch, dtype="float32",
+        data=DataConfig(data_dir=data_dir, synthetic=False, image_size=size,
+                        loader="grain"))
+    # Explicit process args keep jax's backend un-initialized (host-only run).
+    ds = grain_pipeline.build_grain_dataset(
+        cfg, train=True, process_index=0, process_count=1)
+    it = iter(ds)
+    next(it)
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        next(it)
+    return batch * batches / (time.perf_counter() - t0)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--images", type=int, default=512)
@@ -99,7 +118,8 @@ def main(argv=None) -> int:
             return 1
         data_dir = cleanup.name
 
-    for name, fn in [("native_cc", bench_native), ("tf_data", bench_tf)]:
+    for name, fn in [("native_cc", bench_native), ("tf_data", bench_tf),
+                     ("grain", bench_grain)]:
         try:
             rate = fn(data_dir, args.batch, args.image_size, args.batches)
             print(json.dumps({

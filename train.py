@@ -37,6 +37,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--synthetic", action="store_true", default=None,
                    help="on-device synthetic data (config 1)")
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--loader", default=None,
+                   choices=["auto", "tf", "native", "grain"],
+                   help="input pipeline for image datasets")
     p.add_argument("--dp", type=int, default=None, help="data-parallel size")
     p.add_argument("--accum", type=int, default=None,
                    help="gradient-accumulation microbatches per optimizer "
@@ -171,6 +174,8 @@ def build_config(args: argparse.Namespace):
     if args.data_dir:
         data_updates["data_dir"] = args.data_dir
         data_updates["synthetic"] = False
+    if args.loader:
+        data_updates["loader"] = args.loader
     if data_updates:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_updates))
 

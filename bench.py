@@ -48,7 +48,18 @@ def _metric_name_unit(args) -> tuple[str, str]:
         if "bert" in args.model or "gpt" in args.model:  # best effort
             objective = "causal" if "gpt" in args.model else "mlm"
     if objective:
-        return (f"{args.model}_{objective}_s{args.seq_len}"
+        # The head mode is part of the measurement protocol: gN = gather
+        # head over N positions (canonical BERT), no suffix = dense logits.
+        # Keeps gather-mode rows from being compared against the dense-head
+        # numbers recorded under the unsuffixed name.
+        gather = ""
+        if objective == "mlm":
+            mp = args.mlm_max_predictions
+            if mp < 0:
+                mp = int(round(0.15 * args.seq_len))
+            if mp > 0:
+                gather = f"_g{mp}"
+        return (f"{args.model}_{objective}_s{args.seq_len}{gather}"
                 f"_seqs_per_sec_per_chip", "sequences/sec/chip")
     return (f"{args.model}_imagenet_images_per_sec_per_chip",
             "images/sec/chip")
@@ -69,8 +80,14 @@ def _child(args) -> int:
     from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
     n_dev = jax.device_count()
-    tokens = model_spec(args.model).input_kind == "tokens"
-    data = (DataConfig(synthetic=True, dataset="mlm", seq_len=args.seq_len)
+    spec = model_spec(args.model)
+    tokens = spec.input_kind == "tokens"
+    mlm_pred = args.mlm_max_predictions
+    if mlm_pred < 0:  # auto: canonical ~15% gather head for MLM models
+        mlm_pred = (int(round(0.15 * args.seq_len))
+                    if spec.objective == "mlm" else 0)
+    data = (DataConfig(synthetic=True, dataset="mlm", seq_len=args.seq_len,
+                       mlm_max_predictions=mlm_pred)
             if tokens else DataConfig(synthetic=True))
     cfg = TrainConfig(
         model=args.model,
@@ -124,6 +141,10 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--seq-len", type=int, default=512,
                    help="sequence length for token (BERT) models")
+    p.add_argument("--mlm-max-predictions", type=int, default=-1,
+                   help="gather-mode MLM head width; -1 = auto "
+                        "(round(0.15*seq_len), the canonical BERT recipe), "
+                        "0 = dense full-sequence logits")
     p.add_argument("--attention-impl", default=None,
                    choices=[None, "dense", "flash", "ring"],
                    help="attention implementation for token models")
@@ -161,7 +182,8 @@ def main(argv=None) -> int:
                  "--seq-len", str(args.seq_len),
                  "--steps", str(args.steps),
                  "--warmup-steps", str(args.warmup_steps),
-                 "--steps-per-loop", str(args.steps_per_loop)]
+                 "--steps-per-loop", str(args.steps_per_loop),
+                 "--mlm-max-predictions", str(args.mlm_max_predictions)]
     if args.platform:
         child_cmd += ["--platform", args.platform]
     if args.attention_impl:

@@ -66,6 +66,13 @@ class DataConfig:
     seq_len: int = 128
     vocab_size: int = 30522
     mlm_mask_prob: float = 0.15
+    mlm_max_predictions: int = 0  # >0: gather-mode MLM — batches carry fixed-
+                                  # width (masked_positions, masked_labels)
+                                  # and the model projects ONLY those
+                                  # positions to vocab (the canonical BERT /
+                                  # MLPerf head: ~6.7x less head compute +
+                                  # logits memory at 15% masking); 0 = dense
+                                  # (B, S) labels
 
 
 @dataclasses.dataclass

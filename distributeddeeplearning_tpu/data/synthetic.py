@@ -72,21 +72,28 @@ class SyntheticImages(_SyntheticSource):
 
 
 class SyntheticTokens(_SyntheticSource):
-    """Fake MLM batches: ids, mask-labels (-1 = unmasked)."""
+    """Fake MLM batches: ids, mask-labels (-1 = unmasked). With
+    ``max_predictions > 0``, emits gather-mode batches instead — fixed-width
+    (masked_positions, masked_labels) for the projected-positions-only MLM
+    head (config.data.mlm_max_predictions)."""
 
     def __init__(self, batch_size: int, seq_len: int = 128,
                  vocab_size: int = 30522, mask_prob: float = 0.15,
                  seed: int = 0,
-                 sharding: Optional[jax.sharding.Sharding] = None):
+                 sharding: Optional[jax.sharding.Sharding] = None,
+                 max_predictions: int = 0):
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.vocab_size = vocab_size
         self.mask_prob = mask_prob
-        super().__init__(
-            functools.partial(_gen_token_batch, batch=batch_size,
-                              seq_len=seq_len, vocab=vocab_size,
-                              mask_prob=mask_prob),
-            seed, sharding)
+        gen = (functools.partial(_gen_gathered_token_batch, batch=batch_size,
+                                 seq_len=seq_len, vocab=vocab_size,
+                                 max_pred=max_predictions)
+               if max_predictions > 0 else
+               functools.partial(_gen_token_batch, batch=batch_size,
+                                 seq_len=seq_len, vocab=vocab_size,
+                                 mask_prob=mask_prob))
+        super().__init__(gen, seed, sharding)
 
 
 def _gen_image_batch(key, step, *, batch, size, num_classes,
@@ -142,6 +149,23 @@ def _gen_token_batch(key, step, *, batch, seq_len, vocab, mask_prob):
             "attention_mask": jnp.ones((batch, seq_len), jnp.int32)}
 
 
+def _gen_gathered_token_batch(key, step, *, batch, seq_len, vocab, max_pred):
+    """Gather-mode MLM batch: exactly ``max_pred`` distinct masked positions
+    per sequence (sorted), original ids as labels, [MASK] written in."""
+    key = jax.random.fold_in(key, step)
+    k1, k2 = jax.random.split(key)
+    lo = min(1000, vocab // 2)
+    ids = jax.random.randint(k1, (batch, seq_len), lo, vocab, jnp.int32)
+    pos = jax.vmap(lambda k: jax.random.permutation(k, seq_len)[:max_pred])(
+        jax.random.split(k2, batch))
+    pos = jnp.sort(pos, axis=-1).astype(jnp.int32)
+    labels = jnp.take_along_axis(ids, pos, axis=1)
+    input_ids = jax.vmap(lambda row, p: row.at[p].set(MASK_TOKEN_ID))(ids, pos)
+    return {"input_ids": input_ids,
+            "attention_mask": jnp.ones((batch, seq_len), jnp.int32),
+            "masked_positions": pos, "masked_labels": labels}
+
+
 def make_source(config: TrainConfig, input_kind: str = "image",
                 sharding: Optional[jax.sharding.Sharding] = None,
                 objective: str = "classify"):
@@ -155,7 +179,8 @@ def make_source(config: TrainConfig, input_kind: str = "image",
     if input_kind == "tokens":
         return SyntheticTokens(
             config.global_batch_size, d.seq_len, d.vocab_size,
-            d.mlm_mask_prob, config.seed, sharding)
+            d.mlm_mask_prob, config.seed, sharding,
+            max_predictions=d.mlm_max_predictions)
     return SyntheticImages(
         config.global_batch_size, d.image_size, d.num_classes, config.seed,
         sharding, learnable=d.synthetic_learnable)

@@ -83,6 +83,45 @@ def mask_batch(ids: np.ndarray, *, mask_prob: float, vocab_size: int,
             "attention_mask": (ids != PAD_ID).astype(np.int32)}
 
 
+def gather_mask_batch(ids: np.ndarray, *, max_pred: int, mask_prob: float,
+                      vocab_size: int, rng: np.random.Generator) -> dict:
+    """Gather-mode dynamic masking (canonical create_pretraining_data
+    semantics): per row, mask ``min(max_pred, round(maskable * mask_prob))``
+    distinct non-special positions with the 80/10/10 recipe; emit fixed-width
+    sorted ``masked_positions`` + ``masked_labels`` (-1 padding) for the
+    projected-positions-only MLM head."""
+    b, s = ids.shape
+    special = (ids == PAD_ID) | (ids == CLS_ID) | (ids == SEP_ID) | (
+        ids <= UNUSED_MAX)
+    # Vectorized selection (this runs per step on the host hot path): rank
+    # every position by a random key, +1 pushes specials behind all maskable
+    # positions, then each row takes its first `take` ranks.
+    maskable = (~special).sum(axis=1)
+    take = np.minimum(
+        np.minimum(max_pred,
+                   np.maximum(1, np.round(maskable * mask_prob).astype(int))),
+        maskable)
+    order = np.argsort(rng.random(ids.shape) + special, axis=1)[:, :max_pred]
+    valid = np.arange(max_pred)[None, :] < take[:, None]
+    pos_sorted = np.sort(np.where(valid, order, s), axis=1)
+    valid = pos_sorted < s
+    positions = np.where(valid, pos_sorted, 0).astype(np.int32)
+    labels = np.where(valid, np.take_along_axis(ids, positions, axis=1),
+                      -1).astype(np.int32)
+    input_ids = ids.copy()
+    rows = np.broadcast_to(np.arange(b)[:, None], (b, max_pred))
+    roll = rng.random((b, max_pred))
+    m80 = valid & (roll < 0.8)
+    input_ids[rows[m80], positions[m80]] = MASK_TOKEN_ID
+    r10 = valid & (roll >= 0.8) & (roll < 0.9)
+    rand_lo = UNUSED_MAX + 1 if vocab_size > UNUSED_MAX + 2 else 1
+    input_ids[rows[r10], positions[r10]] = rng.integers(
+        rand_lo, vocab_size, int(r10.sum()), dtype=np.int32)
+    return {"input_ids": input_ids,
+            "attention_mask": (ids != PAD_ID).astype(np.int32),
+            "masked_positions": positions, "masked_labels": labels}
+
+
 def _batch_stream(config: TrainConfig, *, train: bool,
                   start_step: int,
                   objective: str = "mlm") -> Iterator[dict]:
@@ -113,8 +152,14 @@ def _batch_stream(config: TrainConfig, *, train: bool,
                 # Mask keyed by (seed, step, proc): deterministic resume.
                 rng = np.random.default_rng(
                     (config.seed * 1_000_003 + step) * 4099 + proc)
-                yield mask_batch(ids, mask_prob=d.mlm_mask_prob,
-                                 vocab_size=d.vocab_size, rng=rng)
+                if d.mlm_max_predictions > 0:
+                    yield gather_mask_batch(
+                        ids, max_pred=d.mlm_max_predictions,
+                        mask_prob=d.mlm_mask_prob,
+                        vocab_size=d.vocab_size, rng=rng)
+                else:
+                    yield mask_batch(ids, mask_prob=d.mlm_mask_prob,
+                                     vocab_size=d.vocab_size, rng=rng)
         step += 1
 
 

@@ -155,14 +155,16 @@ class EncoderLayer(nn.Module):
 
 
 class BertMLM(nn.Module):
-    """Encoder + transform + tied decoder; returns (B, S, vocab) f32 logits."""
+    """Encoder + transform + tied decoder; returns f32 logits of shape
+    (B, S, vocab), or (B, P, vocab) when ``masked_positions`` (B, P) selects
+    the gather-mode head."""
 
     cfg: BertConfig
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None, token_type_ids=None, *,
-                 train: bool = True):
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 masked_positions=None, *, train: bool = True):
         cfg = self.cfg
         deterministic = not train
         b, s = input_ids.shape
@@ -236,6 +238,14 @@ class BertMLM(nn.Module):
                               deterministic=deterministic)
                 x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
+        # Gather-mode head (config.data.mlm_max_predictions): project only
+        # the masked positions to vocab. Every head op below is per-position,
+        # so gathering before the head equals gathering dense logits after it
+        # — at 15% masking that is ~6.7x less head matmul FLOPs and f32
+        # logits traffic (the canonical BERT/MLPerf structure).
+        if masked_positions is not None:
+            x = jnp.take_along_axis(
+                x, masked_positions[:, :, None].astype(jnp.int32), axis=1)
         # MLM head: transform -> LayerNorm -> tied decoder + bias.
         h = _dense(cfg.hidden_size, ("embed", "embed_out"), "mlm_transform",
                    self.dtype)(x)

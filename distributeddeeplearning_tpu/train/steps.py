@@ -75,11 +75,15 @@ def _token_loss_fn(model, config: TrainConfig):
 
     def loss_fn(params, batch_stats, batch, rng):
         del batch_stats
+        kw = {}
+        if "masked_positions" in batch:  # gather-mode head (BertMLM)
+            kw["masked_positions"] = batch["masked_positions"]
         logits, mutated = model.apply(
             {"params": params}, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
-            train=True, rngs={"dropout": rng}, mutable=["moe_losses"])
-        loss = losses.mlm_loss(logits, batch["labels"])
+            train=True, rngs={"dropout": rng}, mutable=["moe_losses"], **kw)
+        loss = losses.mlm_loss(
+            logits, batch.get("masked_labels", batch.get("labels")))
         metrics = {"loss": loss}
         aux_leaves = jax.tree_util.tree_leaves(mutated.get("moe_losses", {}))
         if aux_leaves:
@@ -244,15 +248,19 @@ def make_token_eval_step(model, mesh: Mesh, config: TrainConfig,
     path's psum'd correct-counts, SURVEY.md §3.5)."""
 
     def eval_fn(state: TrainState, batch):
+        kw = {}
+        if objective != "causal" and "masked_positions" in batch:
+            kw["masked_positions"] = batch["masked_positions"]
         with _unreplicated_rules_ctx(config):
             logits = model.apply(
                 {"params": state.params}, batch["input_ids"],
-                attention_mask=batch.get("attention_mask"), train=False)
+                attention_mask=batch.get("attention_mask"), train=False, **kw)
         if objective == "causal":
             s, n = losses.causal_lm_loss_sums(
                 logits, batch["input_ids"], batch.get("attention_mask"))
         else:
-            s, n = losses.mlm_loss_sums(logits, batch["labels"])
+            s, n = losses.mlm_loss_sums(
+                logits, batch.get("masked_labels", batch.get("labels")))
         return {"loss_sum": s, "count": n}
 
     jit_cache: dict = {}

@@ -63,6 +63,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(less activation HBM, ~1/3 more FLOPs)")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")
+    p.add_argument("--mlm-max-predictions", type=int, default=None,
+                   help="gather-mode MLM head: project only this many masked "
+                        "positions to vocab; -1 = auto (round(0.15*seq_len), "
+                        "the canonical BERT recipe); 0/unset = dense "
+                        "full-sequence logits")
     p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw", "lamb"])
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
@@ -171,6 +176,12 @@ def build_config(args: argparse.Namespace):
         data_updates["synthetic"] = True
     if args.seq_len:
         data_updates["seq_len"] = args.seq_len
+    if args.mlm_max_predictions is not None:
+        mp = args.mlm_max_predictions
+        if mp < 0:  # auto: same resolution rule as bench.py
+            seq = data_updates.get("seq_len", cfg.data.seq_len)
+            mp = int(round(0.15 * seq))
+        data_updates["mlm_max_predictions"] = mp
     if args.data_dir:
         data_updates["data_dir"] = args.data_dir
         data_updates["synthetic"] = False

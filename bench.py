@@ -52,13 +52,11 @@ def _metric_name_unit(args) -> tuple[str, str]:
         # head over N positions (canonical BERT), no suffix = dense logits.
         # Keeps gather-mode rows from being compared against the dense-head
         # numbers recorded under the unsuffixed name.
-        gather = ""
-        if objective == "mlm":
-            mp = args.mlm_max_predictions
-            if mp < 0:
-                mp = int(round(0.15 * args.seq_len))
-            if mp > 0:
-                gather = f"_g{mp}"
+        from distributeddeeplearning_tpu.config import (
+            resolve_mlm_max_predictions)
+        mp = resolve_mlm_max_predictions(
+            args.mlm_max_predictions, args.seq_len, objective)
+        gather = f"_g{mp}" if mp > 0 else ""
         return (f"{args.model}_{objective}_s{args.seq_len}{gather}"
                 f"_seqs_per_sec_per_chip", "sequences/sec/chip")
     return (f"{args.model}_imagenet_images_per_sec_per_chip",
@@ -79,13 +77,13 @@ def _child(args) -> int:
     from distributeddeeplearning_tpu.train import loop
     from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
+    from distributeddeeplearning_tpu.config import resolve_mlm_max_predictions
+
     n_dev = jax.device_count()
     spec = model_spec(args.model)
     tokens = spec.input_kind == "tokens"
-    mlm_pred = args.mlm_max_predictions
-    if mlm_pred < 0:  # auto: canonical ~15% gather head for MLM models
-        mlm_pred = (int(round(0.15 * args.seq_len))
-                    if spec.objective == "mlm" else 0)
+    mlm_pred = resolve_mlm_max_predictions(
+        args.mlm_max_predictions, args.seq_len, spec.objective)
     data = (DataConfig(synthetic=True, dataset="mlm", seq_len=args.seq_len,
                        mlm_max_predictions=mlm_pred)
             if tokens else DataConfig(synthetic=True))

@@ -156,6 +156,17 @@ class TrainConfig:
 # them without mutation hazards.
 # ---------------------------------------------------------------------------
 
+def resolve_mlm_max_predictions(value: int, seq_len: int,
+                                objective: str = "mlm") -> int:
+    """One source of truth for the gather-head auto rule shared by
+    train.py/bench.py: -1 resolves to the canonical ``round(0.15*seq_len)``
+    for the mlm objective and to 0 (dense / no-op) for anything else, so a
+    causal model can never silently carry a dead gather config."""
+    if value >= 0:
+        return value if objective == "mlm" else 0
+    return int(round(0.15 * seq_len)) if objective == "mlm" else 0
+
+
 def preset(name: str) -> TrainConfig:
     """Return one of the five acceptance configurations by name."""
     if name == "resnet50_synthetic":      # config 1

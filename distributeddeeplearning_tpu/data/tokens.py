@@ -62,23 +62,33 @@ def _sequence_stream(files: list[str], seq_len: int, *, repeat: bool,
             return
 
 
+def _special_mask(ids: np.ndarray) -> np.ndarray:
+    """Positions that must never be masking targets — ONE definition shared
+    by the dense and gather maskers so the two protocols can't silently
+    diverge on what is maskable."""
+    return (ids == PAD_ID) | (ids == CLS_ID) | (ids == SEP_ID) | (
+        ids <= UNUSED_MAX)
+
+
+def _rand_lo(vocab_size: int) -> int:
+    """Lowest id for 10%-random replacements: skip the reserved range when
+    the vocab is big enough (small test vocabs use the full id space)."""
+    return UNUSED_MAX + 1 if vocab_size > UNUSED_MAX + 2 else 1
+
+
 def mask_batch(ids: np.ndarray, *, mask_prob: float, vocab_size: int,
                rng: np.random.Generator) -> dict:
     """Dynamic BERT masking: labels=-1 except at masked positions; inputs get
     80% [MASK], 10% random id, 10% unchanged."""
-    special = (ids == PAD_ID) | (ids == CLS_ID) | (ids == SEP_ID) | (
-        ids <= UNUSED_MAX)
+    special = _special_mask(ids)
     pick = (rng.random(ids.shape) < mask_prob) & ~special
     labels = np.where(pick, ids, -1).astype(np.int32)
     roll = rng.random(ids.shape)
     input_ids = ids.copy()
     input_ids[pick & (roll < 0.8)] = MASK_TOKEN_ID
     rand_pos = pick & (roll >= 0.8) & (roll < 0.9)
-    # Replacement ids avoid the reserved range when the vocab is big enough
-    # (small test vocabs fall back to the full id space).
-    rand_lo = UNUSED_MAX + 1 if vocab_size > UNUSED_MAX + 2 else 1
     input_ids[rand_pos] = rng.integers(
-        rand_lo, vocab_size, rand_pos.sum(), dtype=np.int32)
+        _rand_lo(vocab_size), vocab_size, rand_pos.sum(), dtype=np.int32)
     return {"input_ids": input_ids, "labels": labels,
             "attention_mask": (ids != PAD_ID).astype(np.int32)}
 
@@ -91,8 +101,7 @@ def gather_mask_batch(ids: np.ndarray, *, max_pred: int, mask_prob: float,
     sorted ``masked_positions`` + ``masked_labels`` (-1 padding) for the
     projected-positions-only MLM head."""
     b, s = ids.shape
-    special = (ids == PAD_ID) | (ids == CLS_ID) | (ids == SEP_ID) | (
-        ids <= UNUSED_MAX)
+    special = _special_mask(ids)
     # Vectorized selection (this runs per step on the host hot path): rank
     # every position by a random key, +1 pushes specials behind all maskable
     # positions, then each row takes its first `take` ranks.
@@ -114,9 +123,8 @@ def gather_mask_batch(ids: np.ndarray, *, max_pred: int, mask_prob: float,
     m80 = valid & (roll < 0.8)
     input_ids[rows[m80], positions[m80]] = MASK_TOKEN_ID
     r10 = valid & (roll >= 0.8) & (roll < 0.9)
-    rand_lo = UNUSED_MAX + 1 if vocab_size > UNUSED_MAX + 2 else 1
     input_ids[rows[r10], positions[r10]] = rng.integers(
-        rand_lo, vocab_size, int(r10.sum()), dtype=np.int32)
+        _rand_lo(vocab_size), vocab_size, int(r10.sum()), dtype=np.int32)
     return {"input_ids": input_ids,
             "attention_mask": (ids != PAD_ID).astype(np.int32),
             "masked_positions": positions, "masked_labels": labels}

@@ -10,7 +10,9 @@ framework forks.
 
 from __future__ import annotations
 
+import signal
 import sys
+import threading
 import time
 from typing import Any, Optional
 
@@ -273,6 +275,24 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         cands.extend(a for a in points if a is not None and a > pos)
         return min(c for c in cands if c > pos)
 
+    # Preemption-aware checkpointing (SURVEY.md §5.3/5.4 extension): Cloud
+    # TPU preemption delivers SIGTERM with a grace window, and the in-repo
+    # launcher's fail-whole path does the same (_terminate_all). Instead of
+    # losing everything since the last cadence save, note the signal and
+    # save synchronously at the next step boundary, then exit nonzero so a
+    # restart wrapper resumes from that exact step. Orbax saves are
+    # collective, so this completes when every process got the signal
+    # (whole-job preemption — the normal case); a partially-signaled job
+    # falls back to the launcher's SIGKILL escalation, no worse than before.
+    preempted: dict[str, Any] = {"signum": None}
+    prev_sigterm = None
+    install_handler = (ckpt is not None and threading.current_thread()
+                       is threading.main_thread())
+    if install_handler:
+        def _on_sigterm(signum, frame):
+            preempted["signum"] = signum
+        prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
@@ -281,6 +301,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
+            if preempted["signum"] is not None:
+                ckpt.maybe_save(i, state, force=True)
+                ckpt.wait()
+                raise SystemExit(
+                    f"preempted (signal {preempted['signum']}): "
+                    f"checkpoint saved at step {i}")
             n = (min(config.steps_per_loop, _next_boundary(i) - i)
                  if fused_runner is not None else 1)
             profile.before_step(i)
@@ -335,6 +361,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # remote-tunneled device costs seconds and would pollute timing.
         jax.device_get((metrics, state.step))
     finally:
+        if install_handler:
+            signal.signal(signal.SIGTERM, prev_sigterm)
         profile.finish()
     if ckpt is not None:
         if total_steps > start_step:

@@ -177,11 +177,13 @@ def build_config(args: argparse.Namespace):
     if args.seq_len:
         data_updates["seq_len"] = args.seq_len
     if args.mlm_max_predictions is not None:
-        mp = args.mlm_max_predictions
-        if mp < 0:  # auto: same resolution rule as bench.py
-            seq = data_updates.get("seq_len", cfg.data.seq_len)
-            mp = int(round(0.15 * seq))
-        data_updates["mlm_max_predictions"] = mp
+        from distributeddeeplearning_tpu.models import model_spec
+        spec = model_spec(cfg.model)
+        data_updates["mlm_max_predictions"] = \
+            cfglib.resolve_mlm_max_predictions(
+                args.mlm_max_predictions,
+                data_updates.get("seq_len", cfg.data.seq_len),
+                spec.objective)
     if args.data_dir:
         data_updates["data_dir"] = args.data_dir
         data_updates["synthetic"] = False

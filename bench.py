@@ -45,8 +45,11 @@ def _metric_name_unit(args) -> tuple[str, str]:
         if spec.input_kind == "tokens":
             objective = spec.objective
     except Exception:
-        if "bert" in args.model or "gpt" in args.model:  # best effort
-            objective = "causal" if "gpt" in args.model else "mlm"
+        name = args.model  # best effort when the registry import fails
+        if "bert" in name:
+            objective = "mlm"
+        elif "gpt" in name or "llama" in name:
+            objective = "causal"
     if objective:
         # The head mode is part of the measurement protocol: gN = gather
         # head over N positions (canonical BERT), no suffix = dense logits.

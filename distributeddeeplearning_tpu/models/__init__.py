@@ -29,7 +29,7 @@ class ModelSpec:
 
 def _registry() -> dict[str, ModelSpec]:
     from distributeddeeplearning_tpu.models import (bert, densenet, gpt,
-                                                    resnet, vit)
+                                                    llama, resnet, vit)
 
     def img(build, name, params):
         return ModelSpec(name=name, build=build, input_kind="image",
@@ -65,6 +65,18 @@ def _registry() -> dict[str, ModelSpec]:
             param_count=354_823_168, objective="causal"),
         "gpt_tiny": ModelSpec(
             name="gpt_tiny", build=gpt.tiny_gpt, input_kind="tokens",
+            param_count=0, objective="causal"),
+        # Llama family (RMSNorm/RoPE/SwiGLU/GQA) — the modern-LM shapes;
+        # llama2_7b's count matches the canonical checkpoint exactly.
+        "llama2_7b": ModelSpec(
+            name="llama2_7b", build=llama.llama2_7b, input_kind="tokens",
+            param_count=6_738_415_616, objective="causal"),
+        "tinyllama_1b": ModelSpec(
+            name="tinyllama_1b", build=llama.tinyllama_1b,
+            input_kind="tokens", param_count=1_100_048_384,
+            objective="causal"),
+        "llama_tiny": ModelSpec(
+            name="llama_tiny", build=llama.tiny_llama, input_kind="tokens",
             param_count=0, objective="causal"),
         # GPT-2 124M as a 4-stage GPipe pipeline over the `pipeline` axis.
         "gpt2_small_pp": ModelSpec(

@@ -84,40 +84,14 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
-        # BertMLM always materializes a bool attention_mask before calling in.
-        if (cfg.attention_impl != "dense" and cfg.dropout_rate > 0
-                and not deterministic):
-            # Runs at trace time — once per compile, not per step.
-            import warnings
-            warnings.warn(
-                f"attention_impl={cfg.attention_impl!r} does not apply "
-                f"attention-probability dropout (the probs are never "
-                f"materialized); training regularization differs from "
-                f"'dense' at dropout_rate={cfg.dropout_rate}. Residual/MLP "
-                f"dropouts still apply.", UserWarning, stacklevel=2)
-        if cfg.attention_impl == "ring":
-            from distributeddeeplearning_tpu.parallel import ring_attention
-            out = ring_attention.ring_attention_sharded(
-                q, k, v, mask).reshape(b, s, -1)
-        elif cfg.attention_impl == "flash":
-            from distributeddeeplearning_tpu.ops.flash_attention import (
-                flash_attention_sharded)
-            out = flash_attention_sharded(q, k, v, mask).reshape(b, s, -1)
-        elif cfg.attention_impl == "dense":
-            scale = head_dim ** -0.5
-            # (B, heads, S, S) scores — contiguous MXU matmuls via einsum.
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            if mask is not None:
-                big_neg = jnp.finfo(jnp.float32).min
-                scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-            probs = nn.softmax(
-                scores.astype(jnp.float32), axis=-1).astype(self.dtype)
-            probs = nn.Dropout(cfg.dropout_rate)(
-                probs, deterministic=deterministic)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
-        else:
-            raise ValueError(
-                f"unknown attention_impl {cfg.attention_impl!r}")
+        from distributeddeeplearning_tpu.ops.attention import (
+            multihead_attention)
+        out = multihead_attention(
+            q, k, v, mask, impl=cfg.attention_impl, causal=False,
+            dtype=self.dtype,
+            prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
+                p, deterministic=deterministic),
+            warn_dropout_rate=cfg.dropout_rate, deterministic=deterministic)
         # Output projection: input dim sharded -> XLA reduces over tp axis.
         return _dense(cfg.hidden_size, ("heads", "embed"), "output", self.dtype)(out)
 

@@ -70,43 +70,14 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
-        if (cfg.attention_impl != "dense" and cfg.dropout_rate > 0
-                and not deterministic):
-            # Trace-time warning (once per compile): flash never
-            # materializes the probs, so attention-prob dropout is skipped.
-            import warnings
-            warnings.warn(
-                f"attention_impl={cfg.attention_impl!r} does not apply "
-                f"attention-probability dropout; training regularization "
-                f"differs from 'dense' at dropout_rate={cfg.dropout_rate}. "
-                f"Residual/MLP dropouts still apply.", UserWarning,
-                stacklevel=2)
-        if cfg.attention_impl == "flash":
-            from distributeddeeplearning_tpu.ops.flash_attention import (
-                flash_attention_sharded)
-            out = flash_attention_sharded(
-                q, k, v, pad_mask, causal=True).reshape(b, s, -1)
-        elif cfg.attention_impl == "ring":
-            # Causal ring: sequence sharded over the `seq` mesh axis,
-            # masking by global position per ring step — long-context GPT.
-            from distributeddeeplearning_tpu.parallel import ring_attention
-            out = ring_attention.ring_attention_sharded(
-                q, k, v, pad_mask, causal=True).reshape(b, s, -1)
-        elif cfg.attention_impl == "dense":
-            scale = head_dim ** -0.5
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            big_neg = jnp.finfo(jnp.float32).min
-            tri = jnp.tril(jnp.ones((s, s), jnp.bool_))
-            keep = tri[None, None] & pad_mask[:, None, None, :]
-            scores = jnp.where(keep, scores, big_neg)
-            probs = nn.softmax(
-                scores.astype(jnp.float32), axis=-1).astype(self.dtype)
-            probs = nn.Dropout(cfg.dropout_rate)(
-                probs, deterministic=deterministic)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
-        else:
-            raise ValueError(
-                f"unknown attention_impl {cfg.attention_impl!r}")
+        from distributeddeeplearning_tpu.ops.attention import (
+            multihead_attention)
+        out = multihead_attention(
+            q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
+            dtype=self.dtype,
+            prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
+                p, deterministic=deterministic),
+            warn_dropout_rate=cfg.dropout_rate, deterministic=deterministic)
         return _dense(cfg.hidden_size, ("heads", "embed"), "output",
                       self.dtype)(out)
 

@@ -1,0 +1,194 @@
+"""Llama-family decoder-only causal LM in Flax, TPU-first.
+
+The modern-LM counterpart to models/gpt.py (beyond the reference's scope,
+like GPT): RMSNorm, rotary position embeddings (no position table — any
+sequence length), SwiGLU MLP, grouped-query attention, no biases, untied
+LM head. Shares the logical-axis sharding rules (tp via ``heads``/``mlp``/
+``vocab``, sp activations, fsdp ``embed``), the causal flash/ring attention
+impls, and the one trainer. The llama2_7b geometry's parameter count
+matches the canonical checkpoint exactly (6,738,415,616 — asserted via
+eval_shape in tests/test_llama.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32          # < num_heads = grouped-query attention
+    intermediate_size: int = 11008
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dropout_rate: float = 0.0       # llama pretraining uses no dropout
+    attention_impl: str = "dense"   # dense | flash | ring (causal)
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _dense(features, logical_axes, name, dtype):
+    return nn.Dense(
+        features, dtype=dtype, param_dtype=jnp.float32, use_bias=False,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), logical_axes),
+        name=name)
+
+
+def _rms_norm(cfg: LlamaConfig, dtype, name: str):
+    return nn.RMSNorm(epsilon=cfg.rms_eps, dtype=dtype,
+                      param_dtype=jnp.float32, name=name)
+
+
+def apply_rope(x, *, theta: float):
+    """Rotary embedding, half-split (rotate_half) convention: x (B, S, H, D)
+    rotated by position along dim 1. f32 rotation regardless of storage
+    dtype (sin/cos in bf16 visibly degrades long-range phase)."""
+    b, s, h, d = x.shape
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask, *, deterministic: bool):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        d = cfg.head_dim
+        q = _dense(cfg.num_heads * d, ("embed", "heads"), "q_proj",
+                   self.dtype)(x).reshape(b, s, cfg.num_heads, d)
+        k = _dense(cfg.num_kv_heads * d, ("embed", "heads"), "k_proj",
+                   self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
+        v = _dense(cfg.num_kv_heads * d, ("embed", "heads"), "v_proj",
+                   self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
+        q = apply_rope(q, theta=cfg.rope_theta)
+        k = apply_rope(k, theta=cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:
+            # GQA: repeat KV groups to full heads for the shared attention
+            # impls (saves KV *parameters/cache*; attention compute matches
+            # MHA — the standard training-time treatment).
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        from distributeddeeplearning_tpu.ops.attention import (
+            multihead_attention)
+        out = multihead_attention(
+            q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
+            dtype=self.dtype, deterministic=deterministic)
+        return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      self.dtype)(out)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-RMSNorm block: x + Attn(norm(x)); x + SwiGLU(norm(x))."""
+
+    cfg: LlamaConfig
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, pad_mask, *, deterministic: bool):
+        cfg = self.cfg
+        h = _rms_norm(cfg, self.dtype, "attention_norm")(x)
+        h = LlamaAttention(cfg, self.dtype, name="attention")(
+            h, pad_mask, deterministic=deterministic)
+        x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        h = _rms_norm(cfg, self.dtype, "mlp_norm")(x)
+        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
+                      self.dtype)(h)
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj",
+                    self.dtype)(h)
+        h = _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj",
+                   self.dtype)(nn.silu(gate) * up)
+        return x + nn.Dropout(cfg.dropout_rate)(
+            h, deterministic=deterministic)
+
+
+class LlamaLM(nn.Module):
+    """Decoder-only LM; returns (B, S, vocab) f32 logits (untied head)."""
+
+    cfg: LlamaConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, *,
+                 train: bool = True):
+        cfg = self.cfg
+        deterministic = not train
+        b, s = input_ids.shape
+        pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
+                    else attention_mask.astype(jnp.bool_))
+
+        embed = self.param(
+            "embed_tokens",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed[input_ids].astype(self.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        for i in range(cfg.num_layers):
+            block = LlamaBlock(cfg, self.dtype, name=f"layer{i}")
+            if cfg.remat:
+                x = nn.remat(
+                    lambda mdl, h, m: mdl(
+                        h, m, deterministic=deterministic))(
+                    block, x, pad_mask)
+            else:
+                x = block(x, pad_mask, deterministic=deterministic)
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        x = _rms_norm(cfg, self.dtype, "final_norm")(x)
+        logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
+                        self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+def llama2_7b(vocab_size: int = 32000, dtype: Dtype = jnp.bfloat16,
+              seq_len: Optional[int] = None, **overrides: Any) -> LlamaLM:
+    """Llama-2-7B geometry (32L/4096H/32 heads, SwiGLU 11008)."""
+    del seq_len  # RoPE: no position table, any sequence length
+    return LlamaLM(LlamaConfig(vocab_size=vocab_size, **overrides),
+                   dtype=dtype)
+
+
+def tinyllama_1b(vocab_size: int = 32000, dtype: Dtype = jnp.bfloat16,
+                 seq_len: Optional[int] = None, **overrides: Any) -> LlamaLM:
+    """TinyLlama-1.1B geometry (22L/2048H/32 heads, 4 KV heads, 5632)."""
+    del seq_len
+    return LlamaLM(
+        LlamaConfig(vocab_size=vocab_size, hidden_size=2048, num_layers=22,
+                    num_heads=32, num_kv_heads=4, intermediate_size=5632,
+                    **overrides), dtype=dtype)
+
+
+def tiny_llama(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
+               seq_len: Optional[int] = None, **overrides: Any) -> LlamaLM:
+    """Test-sized llama (GQA 4 heads / 2 KV heads)."""
+    del seq_len
+    return LlamaLM(
+        LlamaConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    **overrides), dtype=dtype)

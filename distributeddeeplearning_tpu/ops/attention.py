@@ -1,0 +1,77 @@
+"""The one attention-impl dispatch shared by every transformer family
+(models/bert.py, models/gpt.py, models/llama.py).
+
+Three impls, one semantic: softmax(QK^T * d^-1/2 + mask) V with a key-padding
+mask, optionally causal.
+
+- ``dense``: materialized (S, S) scores, f32 softmax, XLA-fused — right for
+  short sequences; the only impl that can apply attention-probability
+  dropout (pass ``prob_dropout``).
+- ``flash``: Pallas TPU kernel (ops/flash_attention.py), O(S·D) HBM traffic,
+  causal variant skips above-diagonal blocks.
+- ``ring``: exact blockwise ring over the ``seq`` mesh axis
+  (parallel/ring_attention.py) — the sharded-sequence long-context path.
+
+Keeping the dispatch here means a masking/dtype/backend fix lands in every
+model family at once instead of drifting across three near-copies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def multihead_attention(q, k, v, pad_mask, *, impl: str, causal: bool,
+                        dtype: Any,
+                        prob_dropout: Optional[Callable] = None,
+                        warn_dropout_rate: float = 0.0,
+                        deterministic: bool = True):
+    """q/k/v: (B, S, H, D); pad_mask: (B, S) bool (True = attend) or None.
+
+    Returns (B, S, H*D) in ``dtype``. ``prob_dropout`` (dense only) is a
+    callable applied to the probabilities — pass a closure constructing
+    ``nn.Dropout`` inside the calling module's scope. ``warn_dropout_rate``
+    triggers the trace-time warning that non-dense impls skip
+    attention-probability dropout.
+    """
+    b, s, h, d = q.shape
+    if pad_mask is None:
+        pad_mask = jnp.ones((b, s), jnp.bool_)
+    pad_mask = pad_mask.astype(jnp.bool_)
+
+    if impl != "dense" and warn_dropout_rate > 0 and not deterministic:
+        # Trace-time (once per compile): flash/ring never materialize the
+        # probs, so attention-probability dropout is skipped.
+        import warnings
+        warnings.warn(
+            f"attention_impl={impl!r} does not apply attention-probability "
+            f"dropout (the probs are never materialized); training "
+            f"regularization differs from 'dense' at "
+            f"dropout_rate={warn_dropout_rate}. Residual/MLP dropouts still "
+            f"apply.", UserWarning, stacklevel=3)
+
+    if impl == "flash":
+        from distributeddeeplearning_tpu.ops.flash_attention import (
+            flash_attention_sharded)
+        out = flash_attention_sharded(q, k, v, pad_mask, causal=causal)
+    elif impl == "ring":
+        from distributeddeeplearning_tpu.parallel import ring_attention
+        out = ring_attention.ring_attention_sharded(
+            q, k, v, pad_mask, causal=causal)
+    elif impl == "dense":
+        scale = d ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        keep = pad_mask[:, None, None, :]
+        if causal:
+            keep = keep & jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None]
+        scores = jnp.where(keep, scores, jnp.finfo(jnp.float32).min)
+        probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+        if prob_dropout is not None:
+            probs = prob_dropout(probs)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    else:
+        raise ValueError(f"unknown attention_impl {impl!r}")
+    return out.reshape(b, s, -1)

@@ -361,8 +361,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # remote-tunneled device costs seconds and would pollute timing.
         jax.device_get((metrics, state.step))
     finally:
+        # prev may be None when the prior handler was installed from C (not
+        # visible to Python) — restoring None would raise inside finally and
+        # mask the propagating exception; SIG_DFL is the honest fallback.
         if install_handler:
-            signal.signal(signal.SIGTERM, prev_sigterm)
+            signal.signal(signal.SIGTERM,
+                          prev_sigterm if prev_sigterm is not None
+                          else signal.SIG_DFL)
         profile.finish()
     if ckpt is not None:
         if total_steps > start_step:

@@ -115,24 +115,28 @@ def test_preemption_sigterm_saves_and_resumes(tmp_path):
            # cadence far beyond the run: only the preemption save writes
            "--checkpoint-every", "100000"]
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Merged stream: blocking on stdout while stderr's pipe fills would
+    # deadlock a warning-heavy child; one pipe can't.
     proc = subprocess.Popen(cmd, cwd=repo, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
+                            stderr=subprocess.STDOUT, text=True)
     try:
         deadline = time.time() + 300
         steps_seen = 0
         while time.time() < deadline:
             line = proc.stdout.readline()
+            if not line:  # child died before producing steps
+                break
             if line.startswith("{\"step\""):
                 steps_seen += 1
                 if steps_seen >= 2:
                     break
         assert steps_seen >= 2, "subprocess produced no steps in time"
         proc.send_signal(signal.SIGTERM)
-        _, err = proc.communicate(timeout=240)
+        out, _ = proc.communicate(timeout=240)
     finally:
         proc.kill()
     assert proc.returncode != 0
-    assert "preempted" in err, err[-800:]
+    assert "preempted" in out, out[-800:]
 
     # Restart with a tiny budget: it must resume from the preemption save
     # (start_step >= the 2 steps we watched complete), not from scratch.

@@ -107,11 +107,16 @@ def build(config: TrainConfig, total_steps: int):
     if (spec.input_kind == "image" and config.grad_accum_steps > 1
             and config.per_device_batch // config.grad_accum_steps < 32
             and jax.process_index() == 0):
-        print(f"# warning: BatchNorm statistics will be computed over only "
-              f"{config.per_device_batch // config.grad_accum_steps} examples "
-              f"per microbatch (per_device_batch={config.per_device_batch}, "
-              f"grad_accum_steps={config.grad_accum_steps}); consider "
-              f"lowering --accum", file=sys.stderr, flush=True)
+        import warnings
+
+        # warnings.warn (not a raw stderr print): dedupes across repeat
+        # builds and lets deliberate small-batch harnesses filter it.
+        warnings.warn(
+            f"BatchNorm statistics will be computed over only "
+            f"{config.per_device_batch // config.grad_accum_steps} examples "
+            f"per microbatch (per_device_batch={config.per_device_batch}, "
+            f"grad_accum_steps={config.grad_accum_steps}); consider "
+            f"lowering --accum", UserWarning, stacklevel=2)
     rng = jax.random.key(config.seed)
 
     seq_dim = 1 if spec.input_kind == "tokens" else None

@@ -1,0 +1,179 @@
+"""Numerics parity vs HuggingFace transformers (torch CPU), SURVEY.md §4:
+with identical weights, our forward must match the canonical architecture
+implementation — the strongest available substitute for reference parity
+while /root/reference is empty. Models are instantiated offline from
+configs (random init, no downloads); HF weights are mapped into our
+pytrees and logits compared."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from distributeddeeplearning_tpu.models import bert, gpt, llama  # noqa: E402
+
+
+def _t(x):  # torch weight -> numpy
+    return x.detach().cpu().numpy()
+
+
+def test_llama_forward_matches_hf():
+    """Tiny llama (GQA 4 heads / 2 KV) vs transformers.LlamaForCausalLM:
+    validates RoPE convention, GQA repeat, SwiGLU, RMSNorm, untied head."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, mlp_bias=False, tie_word_embeddings=False,
+        attention_dropout=0.0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+
+    def layer(i):
+        p = f"model.layers.{i}."
+        return {
+            "attention_norm": {"scale": _t(sd[p + "input_layernorm.weight"])},
+            "mlp_norm": {"scale": _t(sd[p + "post_attention_layernorm.weight"])},
+            "attention": {
+                "q_proj": {"kernel": _t(sd[p + "self_attn.q_proj.weight"]).T},
+                "k_proj": {"kernel": _t(sd[p + "self_attn.k_proj.weight"]).T},
+                "v_proj": {"kernel": _t(sd[p + "self_attn.v_proj.weight"]).T},
+                "o_proj": {"kernel": _t(sd[p + "self_attn.o_proj.weight"]).T},
+            },
+            "gate_proj": {"kernel": _t(sd[p + "mlp.gate_proj.weight"]).T},
+            "up_proj": {"kernel": _t(sd[p + "mlp.up_proj.weight"]).T},
+            "down_proj": {"kernel": _t(sd[p + "mlp.down_proj.weight"]).T},
+        }
+
+    params = {
+        "embed_tokens": _t(sd["model.embed_tokens.weight"]),
+        "final_norm": {"scale": _t(sd["model.norm.weight"])},
+        "lm_head": {"kernel": _t(sd["lm_head.weight"]).T},
+        **{f"layer{i}": layer(i) for i in range(2)},
+    }
+
+    ours = llama.tiny_llama(vocab_size=256, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (2, 16))
+    ours_logits = np.asarray(ours.apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32), train=False))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours_logits, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_forward_matches_hf():
+    """Tiny GPT-2 vs transformers.GPT2LMHeadModel: validates pre-LN blocks,
+    fused-qkv split, tanh-gelu MLP, learned positions, tied head. HF GPT-2
+    uses Conv1D ([in, out] weights — no transpose)."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5, activation_function="gelu_new")
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = hf.state_dict()
+
+    def ln(prefix):
+        return {"scale": _t(sd[prefix + ".weight"]),
+                "bias": _t(sd[prefix + ".bias"])}
+
+    def layer(i):
+        p = f"transformer.h.{i}."
+        qkv_w = _t(sd[p + "attn.c_attn.weight"])   # (h, 3h), Conv1D layout
+        qkv_b = _t(sd[p + "attn.c_attn.bias"])
+        h = qkv_w.shape[0]
+        return {
+            "ln1": ln(p + "ln_1"),
+            "ln2": ln(p + "ln_2"),
+            "attention": {
+                "query": {"kernel": qkv_w[:, :h], "bias": qkv_b[:h]},
+                "key": {"kernel": qkv_w[:, h:2 * h], "bias": qkv_b[h:2 * h]},
+                "value": {"kernel": qkv_w[:, 2 * h:], "bias": qkv_b[2 * h:]},
+                "output": {"kernel": _t(sd[p + "attn.c_proj.weight"]),
+                           "bias": _t(sd[p + "attn.c_proj.bias"])},
+            },
+            "mlp_in": {"kernel": _t(sd[p + "mlp.c_fc.weight"]),
+                       "bias": _t(sd[p + "mlp.c_fc.bias"])},
+            "mlp_out": {"kernel": _t(sd[p + "mlp.c_proj.weight"]),
+                        "bias": _t(sd[p + "mlp.c_proj.bias"])},
+        }
+
+    params = {
+        "wte": _t(sd["transformer.wte.weight"]),
+        "wpe": _t(sd["transformer.wpe.weight"]),
+        "ln_f": ln("transformer.ln_f"),
+        **{f"layer{i}": layer(i) for i in range(2)},
+    }
+
+    ours = gpt.tiny_gpt(vocab_size=256, dtype=jnp.float32, dropout_rate=0.0,
+                        max_position=64)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 256, (2, 16))
+    ours_logits = np.asarray(ours.apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32), train=False))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours_logits, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_forward_matches_hf():
+    """Tiny BERT MLM vs transformers.BertForMaskedLM: validates embeddings
+    (word+pos+type, post-LN), post-LN encoder, and the tied MLM head."""
+    hf_cfg = transformers.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12, hidden_act="gelu")
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    hf.tie_weights()
+    sd = hf.state_dict()
+
+    def ln(prefix):
+        return {"scale": _t(sd[prefix + ".weight"]),
+                "bias": _t(sd[prefix + ".bias"])}
+
+    def dense(prefix):
+        return {"kernel": _t(sd[prefix + ".weight"]).T,
+                "bias": _t(sd[prefix + ".bias"])}
+
+    def layer(i):
+        p = f"bert.encoder.layer.{i}."
+        return {
+            "attention": {
+                "query": dense(p + "attention.self.query"),
+                "key": dense(p + "attention.self.key"),
+                "value": dense(p + "attention.self.value"),
+                "output": dense(p + "attention.output.dense"),
+            },
+            "attention_ln": ln(p + "attention.output.LayerNorm"),
+            "intermediate": dense(p + "intermediate.dense"),
+            "mlp_output": dense(p + "output.dense"),
+            "mlp_ln": ln(p + "output.LayerNorm"),
+        }
+
+    params = {
+        "word_embeddings": _t(sd["bert.embeddings.word_embeddings.weight"]),
+        "position_embeddings": _t(
+            sd["bert.embeddings.position_embeddings.weight"]),
+        "type_embeddings": _t(
+            sd["bert.embeddings.token_type_embeddings.weight"]),
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+        "mlm_transform": dense("cls.predictions.transform.dense"),
+        "mlm_ln": ln("cls.predictions.transform.LayerNorm"),
+        "mlm_bias": _t(sd["cls.predictions.bias"]),
+        **{f"layer{i}": layer(i) for i in range(2)},
+    }
+
+    ours = bert.tiny_bert_mlm(vocab_size=256, dtype=jnp.float32,
+                              dropout_rate=0.0)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 256, (2, 16))
+    ours_logits = np.asarray(ours.apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32), train=False))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours_logits, hf_logits, rtol=2e-4, atol=2e-4)

@@ -55,8 +55,10 @@ class DenseNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
 
         x = jnp.asarray(x, self.dtype)
+        # Explicit (3,3) stem padding: torch-symmetric, like models/resnet.py
+        # (XLA SAME would pad (2,3) at stride 2 — a one-pixel shift).
         x = conv(self.num_init_features, (7, 7), strides=(2, 2),
-                 name="conv_stem")(x)
+                 padding=[(3, 3), (3, 3)], name="conv_stem")(x)
         x = norm(name="bn_stem")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

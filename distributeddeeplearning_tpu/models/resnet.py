@@ -41,9 +41,12 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = self.act(y)
-        # v1.5: stride lives on the 3x3, not the first 1x1.
+        # v1.5: stride lives on the 3x3, not the first 1x1. Explicit (1,1)
+        # padding: XLA's SAME pads (0,1) at stride 2, torch pads (1,1) —
+        # symmetric keeps us numerically identical to the reference-era
+        # torch trainers (tests/test_torch_parity.py).
         y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
-                      name="conv2")(y)
+                      padding=[(1, 1), (1, 1)], name="conv2")(y)
         y = self.norm(name="bn2")(y)
         y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
@@ -69,7 +72,7 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
-                      name="conv1")(x)
+                      padding=[(1, 1), (1, 1)], name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = self.act(y)
         y = self.conv(self.filters, (3, 3), name="conv2")(y)
@@ -104,7 +107,10 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = jnp.asarray(x, self.dtype)
-        x = conv(self.width, (7, 7), strides=(2, 2), name="conv_stem")(x)
+        # Explicit (3,3): torch's symmetric stem padding (SAME would pad
+        # (2,3) on 224 at stride 2 — a one-pixel shift vs the reference).
+        x = conv(self.width, (7, 7), strides=(2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_stem")(x)
         x = norm(name="bn_stem")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

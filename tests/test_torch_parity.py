@@ -1,0 +1,128 @@
+"""ResNet50 forward parity vs a canonical torch implementation (SURVEY.md
+§4 "Numerics"): the reference's trainers used the torchvision ResNet50-v1.5;
+torchvision itself is not in this image, so the test carries the published
+architecture in plain torch.nn (Bottleneck v1.5, symmetric padding, BN
+eps 1e-5) and maps identical weights into our Flax model. Eval-mode logits
+must agree — validating conv padding/stride arithmetic, BN inference
+semantics, pooling, and the classifier wiring across frameworks."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+nn = torch.nn
+
+from distributeddeeplearning_tpu import models  # noqa: E402
+
+
+class _Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, filters, stride):
+        super().__init__()
+        cout = filters * 4
+        self.conv1 = nn.Conv2d(cin, filters, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(filters)
+        self.conv2 = nn.Conv2d(filters, filters, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(filters)
+        self.conv3 = nn.Conv2d(filters, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = torch.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return torch.relu(y + idn)
+
+
+class _TorchResNet50(nn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        cin = 64
+        self.layers = nn.ModuleList()
+        for i, blocks in enumerate([3, 4, 6, 3]):
+            stage = nn.ModuleList()
+            for j in range(blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                stage.append(_Bottleneck(cin, 64 * 2 ** i, stride))
+                cin = 64 * 2 ** i * 4
+            self.layers.append(stage)
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for stage in self.layers:
+            for block in stage:
+                x = block(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def _conv(w):  # torch (O, I, H, W) -> flax (H, W, I, O)
+    return w.detach().numpy().transpose(2, 3, 1, 0)
+
+
+def _bn(mod):
+    return ({"scale": mod.weight.detach().numpy(),
+             "bias": mod.bias.detach().numpy()},
+            {"mean": mod.running_mean.detach().numpy(),
+             "var": mod.running_var.detach().numpy()})
+
+
+def test_resnet50_forward_matches_torch():
+    ref = _TorchResNet50()
+    # Perturb BN running stats away from init (mean 0 / var 1) so the
+    # inference-normalization path is actually exercised.
+    g = torch.Generator().manual_seed(0)
+    for m in ref.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn(m.running_mean.shape,
+                                             generator=g) * 0.1)
+            m.running_var.copy_(1.0 + 0.2 * torch.rand(m.running_var.shape,
+                                                       generator=g))
+    ref.eval()
+
+    params: dict = {}
+    stats: dict = {}
+    params["conv_stem"] = {"kernel": _conv(ref.conv1.weight)}
+    params["bn_stem"], stats["bn_stem"] = _bn(ref.bn1)
+    for i, stage in enumerate(ref.layers):
+        for j, block in enumerate(stage):
+            key = f"stage{i + 1}_block{j + 1}"
+            p = {"conv1": {"kernel": _conv(block.conv1.weight)},
+                 "conv2": {"kernel": _conv(block.conv2.weight)},
+                 "conv3": {"kernel": _conv(block.conv3.weight)}}
+            s = {}
+            p["bn1"], s["bn1"] = _bn(block.bn1)
+            p["bn2"], s["bn2"] = _bn(block.bn2)
+            p["bn3"], s["bn3"] = _bn(block.bn3)
+            if block.downsample is not None:
+                p["downsample_conv"] = {
+                    "kernel": _conv(block.downsample[0].weight)}
+                p["downsample_bn"], s["downsample_bn"] = _bn(
+                    block.downsample[1])
+            params[key] = p
+            stats[key] = s
+    params["classifier"] = {"kernel": ref.fc.weight.detach().numpy().T,
+                            "bias": ref.fc.bias.detach().numpy()}
+
+    ours = models.get_model("resnet50", dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 64, 64, 3), np.float32)
+    ours_logits = np.asarray(ours.apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x), train=False))
+    with torch.no_grad():
+        ref_logits = ref(torch.tensor(x).permute(0, 3, 1, 2)).numpy()
+    np.testing.assert_allclose(ours_logits, ref_logits, rtol=2e-4, atol=2e-4)

@@ -190,3 +190,14 @@ def test_preemption_resume_start_step(tmp_path, quiet):
     resumed = loop.run(cfg, total_steps=saved + 1, logger=quiet)
     assert resumed["start_step"] == saved
     assert resumed["final_step"] == saved + 1
+
+
+def test_eval_only_restores_and_scores(tmp_path, quiet):
+    """--eval-only semantics: total_steps=0 + resume restores the newest
+    checkpoint and jumps straight to final held-out eval, training nothing."""
+    cfg = tiny_cfg(checkpoint_dir=str(tmp_path / "ckpt"))
+    loop.run(cfg, total_steps=3, logger=quiet)
+    summary = loop.run(cfg, total_steps=0, logger=quiet, eval_batches=2)
+    assert summary["start_step"] == 3
+    assert summary["final_step"] == 3
+    assert 0.0 <= summary["eval_top1"] <= 1.0

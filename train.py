@@ -80,6 +80,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="periodic + final held-out eval over N batches "
                         "(top-1 for image models, loss/perplexity for "
                         "token models)")
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore the newest checkpoint and run held-out "
+                        "eval without training (requires --checkpoint-dir "
+                        "and --eval-batches)")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore existing checkpoints in --checkpoint-dir")
     p.add_argument("--profile-steps", default=None, metavar="A,B",
@@ -226,7 +230,24 @@ def main(argv=None) -> int:
     from distributeddeeplearning_tpu.models import model_spec
 
     total_steps = args.steps
-    if total_steps is None:
+    if args.eval_only:
+        if not (args.checkpoint_dir and args.eval_batches):
+            raise SystemExit(
+                "--eval-only needs --checkpoint-dir (the model to restore) "
+                "and --eval-batches (how much of the held-out split to "
+                "score)")
+        if args.no_resume:
+            raise SystemExit(
+                "--eval-only with --no-resume would score freshly "
+                "initialized weights; drop --no-resume")
+        if total_steps is not None:
+            raise SystemExit(
+                f"--eval-only trains nothing; drop --steps {total_steps} "
+                "(or drop --eval-only to train then eval)")
+        # total_steps=0 with resume: the restored step lands past the
+        # (empty) training range, so the loop skips straight to final eval.
+        total_steps = 0
+    elif total_steps is None:
         if model_spec(cfg.model).input_kind == "tokens":
             # MLM pretraining is step-based (no canonical "epoch"); require
             # an explicit step budget rather than inventing one.
@@ -248,6 +269,12 @@ def main(argv=None) -> int:
                        warmup_steps=min(args.warmup_steps, total_steps - 1)
                        if total_steps > 1 else 0,
                        eval_batches=args.eval_batches, logger=logger)
+    if args.eval_only and summary["start_step"] == 0:
+        # Nothing restored (empty/typo'd dir): a score of random init would
+        # be indistinguishable from a real (bad) model in the summary.
+        raise SystemExit(
+            f"--eval-only: no checkpoint found in {cfg.checkpoint_dir!r}; "
+            "refusing to score randomly initialized weights")
     import jax
     if jax.process_index() == 0:
         print(json.dumps({"summary": summary}), flush=True)

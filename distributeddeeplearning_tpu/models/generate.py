@@ -1,0 +1,65 @@
+"""Autoregressive generation for the causal LM families (GPT, Llama).
+
+Deliberately the simple-and-correct formulation: one fixed-shape padded
+forward per emitted token inside a single jitted ``lax.scan`` — no KV-cache
+plumbing in the models, so it works unchanged for every causal variant
+(dense/flash attention, remat, pipelined). O(S^2) per token is irrelevant
+at eval-demo scale; a cached decode path is a later optimization, not a
+correctness requirement.
+
+Sampling: greedy (temperature=0) or temperature softmax with optional
+top-k truncation. Fully deterministic given (params, prompt, seed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def generate(model, variables, prompt_ids, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Optional[jax.Array] = None, pad_id: int = 0):
+    """Extend ``prompt_ids`` (B, P) by ``max_new_tokens`` tokens.
+
+    Returns (B, P + max_new_tokens) int32. The sequence buffer is padded to
+    the final length up front; the attention mask marks the live prefix, so
+    every scan step runs the same fixed-shape forward (one compile).
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    b, p = prompt_ids.shape
+    total = p + max_new_tokens
+    if rng is None:
+        rng = jax.random.key(0)
+
+    ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
+    mask0 = (jnp.arange(total)[None, :] < p).astype(jnp.int32)
+    mask0 = jnp.broadcast_to(mask0, (b, total))
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        k = min(top_k, logits.shape[-1])  # top_k >= vocab = full sampling
+        if k > 0:
+            kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    def step(carry, _):
+        ids, mask, pos, key = carry
+        logits = model.apply(variables, ids, attention_mask=mask,
+                             train=False)                  # (B, total, V)
+        next_logits = jax.lax.dynamic_slice_in_dim(
+            logits, pos - 1, 1, axis=1)[:, 0]              # (B, V)
+        key, sub = jax.random.split(key)
+        tok = sample(next_logits, sub)
+        ids = ids.at[:, pos].set(tok)
+        mask = mask.at[:, pos].set(1)
+        return (ids, mask, pos + 1, key), tok
+
+    (ids, _, _, _), _ = jax.lax.scan(
+        step, (ids0, mask0, jnp.int32(p), rng), None, length=max_new_tokens)
+    return ids

@@ -77,6 +77,75 @@ class Checkpointer:
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(_abstract_like(state_like)))
 
+    def _restore_subtree(self, raw_subtree: Any, like: Any, what: str) -> Any:
+        """Unwrap serialized sharding boxes, check structure AND shapes
+        against ``like``, and place leaves onto ``like``'s shardings."""
+        from flax.core import meta
+
+        # Sharding-metadata boxes (LogicallyPartitioned) serialize as
+        # single-key {'value': leaf} dicts; unwrap them.
+        def _is_box(n):
+            return (isinstance(n, dict) and set(n) == {"value"}
+                    and not isinstance(n["value"], dict))
+
+        tree = jax.tree_util.tree_map(
+            lambda n: n["value"] if _is_box(n) else n,
+            raw_subtree, is_leaf=_is_box)
+        like = meta.unbox(like)
+        if (jax.tree_util.tree_structure(tree)
+                != jax.tree_util.tree_structure(like)):
+            raise ValueError(
+                f"checkpoint {what} structure does not match the model: "
+                f"saved {jax.tree_util.tree_structure(tree)} vs expected "
+                f"{jax.tree_util.tree_structure(like)}")
+
+        def place(arr, ref):
+            if tuple(arr.shape) != tuple(ref.shape):
+                raise ValueError(
+                    f"checkpoint {what} shape mismatch: saved {arr.shape} "
+                    f"vs model {ref.shape} — e.g. a position table trained "
+                    f"at a shorter context; rebuild the model to match the "
+                    f"checkpoint (seq_len / max-new-tokens)")
+            return jax.device_put(arr, ref.sharding)
+
+        return jax.tree_util.tree_map(place, tree, like)
+
+    def restore_latest_params(self, params_like: Any) -> Optional[Any]:
+        """Restore ONLY the model parameters from the newest checkpoint.
+
+        For consumers that don't train (generate.py): the optimizer state's
+        structure depends on the training run's optimizer choice, which a
+        sampler neither knows nor needs. Uses a raw (target-less) restore —
+        this orbax version has no partial StandardRestore — so the whole
+        tree loads to host once; sampler-scale only."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(step)
+        return self._restore_subtree(restored["params"], params_like,
+                                     "params")
+
+    def restore_latest_for_eval(self, state_like: Any) -> Optional[Any]:
+        """Restore params + BN statistics + step — everything inference
+        needs — keeping ``state_like``'s (fresh) optimizer state, so
+        eval-only runs don't have to repeat the training run's optimizer
+        flags to satisfy a StandardRestore structure match."""
+        import jax.numpy as jnp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(step)
+        params = self._restore_subtree(restored["params"], state_like.params,
+                                       "params")
+        batch_stats = state_like.batch_stats
+        if batch_stats is not None:
+            batch_stats = self._restore_subtree(
+                restored["batch_stats"], batch_stats, "batch_stats")
+        return state_like.replace(
+            step=jnp.asarray(restored["step"], jnp.int32),
+            params=params, batch_stats=batch_stats)
+
     def verify_or_record_stream_meta(self, meta: dict) -> None:
         """Pin environment-dependent data-stream facts (e.g. the resolved
         ``auto`` loader) to the checkpoint directory.

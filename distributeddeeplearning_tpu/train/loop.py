@@ -160,7 +160,8 @@ def build(config: TrainConfig, total_steps: int):
 def run(config: TrainConfig, *, total_steps: int,
         logger: Optional[MetricLogger] = None,
         warmup_steps: int = 0, eval_batches: int = 0,
-        return_state: bool = False) -> dict[str, Any]:
+        return_state: bool = False,
+        restore_for_eval: bool = False) -> dict[str, Any]:
     """Train for ``total_steps``; returns a summary with throughput.
 
     ``warmup_steps`` are excluded from timing (compile + first-step cost),
@@ -184,7 +185,7 @@ def run(config: TrainConfig, *, total_steps: int,
             config, spec, mesh, model, batch_shd, state, train_step, sched,
             rng, ckpt, logger, total_steps=total_steps,
             warmup_steps=warmup_steps, eval_batches=eval_batches,
-            return_state=return_state)
+            return_state=return_state, restore_for_eval=restore_for_eval)
     finally:
         if ckpt is not None:
             ckpt.close()  # releases the async-checkpointing executor
@@ -192,7 +193,7 @@ def run(config: TrainConfig, *, total_steps: int,
 
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
-               return_state) -> dict[str, Any]:
+               return_state, restore_for_eval=False) -> dict[str, Any]:
     if config.fail_at_step is not None and config.fail_at_step > total_steps:
         raise ValueError(
             f"fail_at_step={config.fail_at_step} is beyond "
@@ -205,7 +206,11 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # order) fails loudly instead (ADVICE r1 #1).
         ckpt.verify_or_record_stream_meta({"loader": resolved_loader})
     if ckpt is not None and config.resume:
-        restored = ckpt.restore_latest(state)
+        # restore_for_eval: params/BN/step only, fresh optimizer state — an
+        # eval-only consumer must not have to repeat the training run's
+        # optimizer flags to satisfy the full-state structure match.
+        restored = (ckpt.restore_latest_for_eval(state) if restore_for_eval
+                    else ckpt.restore_latest(state))
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state.step))

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Sampling CLI for the causal LM families — restore a checkpoint, extend
+prompts.
+
+    python generate.py --model gpt2_small --checkpoint-dir /ckpts/run1 \
+        --prompt-ids 464,3290,318 --max-new-tokens 32 --temperature 0.8
+
+Prompts are raw token ids (comma-separated; `--prompt-ids` repeatable for a
+batch) — tokenization is corpus-specific and lives with the data tooling
+(tools/tokenize_corpus.py), not the sampler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="gpt2_small")
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--prompt-ids", action="append", required=True,
+                   help="comma-separated token ids; repeat for a batch "
+                        "(rows must share a length)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="model context length (defaults to prompt+new)")
+    p.add_argument("--vocab-size", type=int, default=None)
+    p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    args = p.parse_args(argv)
+
+    import os
+    if args.backend == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+    from distributeddeeplearning_tpu.models import model_spec
+    from distributeddeeplearning_tpu.models.generate import generate
+    from distributeddeeplearning_tpu.train import checkpoint as ckptlib
+    from distributeddeeplearning_tpu.train import loop
+
+    prompts = [[int(t) for t in row.split(",")] for row in args.prompt_ids]
+    if len({len(r) for r in prompts}) != 1:
+        raise SystemExit("all --prompt-ids rows must share a length")
+    total = len(prompts[0]) + args.max_new_tokens
+
+    spec = model_spec(args.model)
+    if spec.objective != "causal":
+        raise SystemExit(f"{args.model!r} is not a causal LM")
+    data_kw = dict(synthetic=True, seq_len=args.seq_len or total)
+    if args.vocab_size:
+        data_kw["vocab_size"] = args.vocab_size
+    cfg = TrainConfig(model=args.model, global_batch_size=len(prompts),
+                      dtype="float32", checkpoint_dir=args.checkpoint_dir,
+                      backend=args.backend, data=DataConfig(**data_kw))
+
+    mesh, model, _, state, _, _, _ = loop.build(cfg, total_steps=1)
+    ckpt = ckptlib.Checkpointer.create(cfg)
+    try:
+        # Params-only partial restore: the sampler must not need to know
+        # which optimizer the training run used.
+        params = ckpt.restore_latest_params(state.params)
+    finally:
+        ckpt.close()
+    if params is None:
+        raise SystemExit(
+            f"no checkpoint in {args.checkpoint_dir!r}; refusing to sample "
+            "from randomly initialized weights")
+
+    out = generate(model, {"params": params}, prompts,
+                   max_new_tokens=args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   rng=jax.random.key(args.seed))
+    for row in jax.device_get(out).tolist():
+        print(json.dumps({"tokens": row}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

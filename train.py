@@ -231,19 +231,31 @@ def main(argv=None) -> int:
 
     total_steps = args.steps
     if args.eval_only:
-        if not (args.checkpoint_dir and args.eval_batches):
+        if not (args.checkpoint_dir and args.eval_batches > 0):
             raise SystemExit(
                 "--eval-only needs --checkpoint-dir (the model to restore) "
-                "and --eval-batches (how much of the held-out split to "
-                "score)")
+                "and a positive --eval-batches (how much of the held-out "
+                "split to score)")
         if args.no_resume:
             raise SystemExit(
                 "--eval-only with --no-resume would score freshly "
                 "initialized weights; drop --no-resume")
-        if total_steps is not None:
+        if total_steps is not None or args.epochs:
             raise SystemExit(
-                f"--eval-only trains nothing; drop --steps {total_steps} "
+                "--eval-only trains nothing; drop --steps/--epochs "
                 "(or drop --eval-only to train then eval)")
+        # Refuse an empty/typo'd directory BEFORE paying for compile + a
+        # full eval of randomly initialized weights.
+        from distributeddeeplearning_tpu.train import checkpoint as ckptlib
+        ck = ckptlib.Checkpointer.create(cfg)
+        try:
+            if ck.latest_step() is None:
+                raise SystemExit(
+                    f"--eval-only: no checkpoint found in "
+                    f"{cfg.checkpoint_dir!r}; refusing to score randomly "
+                    f"initialized weights")
+        finally:
+            ck.close()
         # total_steps=0 with resume: the restored step lands past the
         # (empty) training range, so the loop skips straight to final eval.
         total_steps = 0
@@ -268,10 +280,11 @@ def main(argv=None) -> int:
     summary = loop.run(cfg, total_steps=total_steps,
                        warmup_steps=min(args.warmup_steps, total_steps - 1)
                        if total_steps > 1 else 0,
-                       eval_batches=args.eval_batches, logger=logger)
+                       eval_batches=args.eval_batches, logger=logger,
+                       restore_for_eval=args.eval_only)
     if args.eval_only and summary["start_step"] == 0:
-        # Nothing restored (empty/typo'd dir): a score of random init would
-        # be indistinguishable from a real (bad) model in the summary.
+        # Backstop for a checkpoint that vanished between the pre-check and
+        # the restore: never report a random-init score as a valid summary.
         raise SystemExit(
             f"--eval-only: no checkpoint found in {cfg.checkpoint_dir!r}; "
             "refusing to score randomly initialized weights")

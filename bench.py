@@ -164,8 +164,11 @@ def main(argv=None) -> int:
                         "per-step dispatch is the bottleneck")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu) for smoke runs")
-    p.add_argument("--attempt-timeout", type=int, default=600,
-                   help="hard wall-clock limit per measurement attempt (s)")
+    p.add_argument("--attempt-timeout", type=int, default=480,
+                   help="hard wall-clock limit per measurement attempt (s); "
+                        "a live-chip run measures in ~240 s, and a hanging "
+                        "backend must leave the parent time to print the "
+                        "error record before any outer driver timeout")
     p.add_argument("--attempts", type=int, default=3)
     p.add_argument("--budget", type=int, default=1200,
                    help="total wall-clock budget across all attempts (s); "

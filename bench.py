@@ -138,8 +138,11 @@ def _note(msg: str) -> None:
     print(f"# bench: {msg}", file=sys.stderr, flush=True)
 
 
-def _child_measure(args) -> None:
-    """One config: compile once, emit quick then full-protocol lines."""
+def _child_measure(args, emit_quick: bool = True) -> None:
+    """One config: compile once, emit quick then full-protocol lines.
+
+    ``emit_quick=False`` (suite mode) keeps the quick window as pure warmup
+    so each config contributes exactly one metric line."""
     import jax
 
     from distributeddeeplearning_tpu import data as datalib
@@ -194,8 +197,9 @@ def _child_measure(args) -> None:
         i += 1
     jax.device_get(metrics)
     elapsed = time.perf_counter() - t0
-    _emit_metric(args, cfg.global_batch_size * quick_n / elapsed / n_dev,
-                 protocol=f"quick w{quick_w}+{quick_n} b{args.batch_size}")
+    if emit_quick:
+        _emit_metric(args, cfg.global_batch_size * quick_n / elapsed / n_dev,
+                     protocol=f"quick w{quick_w}+{quick_n} b{args.batch_size}")
     # Full-protocol window: everything so far (quick_w + quick_n >= the
     # classic 10) counts as warmup; time a fresh window of args.steps.
     t0 = time.perf_counter()
@@ -238,7 +242,7 @@ def _child(args) -> int:
         for k, v in overrides.items():
             setattr(row, k, v)
         try:
-            _child_measure(row)
+            _child_measure(row, emit_quick=False)
         except Exception as e:  # one OOM must not sink the rest of the suite
             metric, unit = _metric_name_unit(row)
             print(json.dumps({
@@ -285,7 +289,7 @@ def _run_attempt(child_cmd, timeout: float, *,
     env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=COMPILE_CACHE_DIR)
     proc = subprocess.Popen(child_cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, env=env)
-    relayed = [0]
+    relayed = [0, 0]  # [measurements, error records]
     err_lines: list[str] = []
 
     def _pump_out():
@@ -299,6 +303,7 @@ def _run_attempt(child_cmd, timeout: float, *,
                 relayed[0] += 1
             elif relay_errors:
                 print(line, flush=True)
+                relayed[1] += 1
 
     def _pump_err():
         for line in proc.stderr:
@@ -317,7 +322,7 @@ def _run_attempt(child_cmd, timeout: float, *,
         rc = f"timeout {int(timeout)}s"
     for t in threads:
         t.join(timeout=5)
-    return relayed[0], "\n".join(err_lines), rc
+    return relayed[0] + relayed[1], "\n".join(err_lines), rc
 
 
 def main(argv=None) -> int:

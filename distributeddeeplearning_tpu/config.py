@@ -161,9 +161,13 @@ def resolve_mlm_max_predictions(value: int, seq_len: int,
     """One source of truth for the gather-head auto rule shared by
     train.py/bench.py: -1 resolves to the canonical ``round(0.15*seq_len)``
     for the mlm objective and to 0 (dense / no-op) for anything else, so a
-    causal model can never silently carry a dead gather config."""
+    causal model can never silently carry a dead gather config. Explicit
+    values are clamped to ``seq_len`` — a wider head is meaningless (at most
+    seq_len positions can be masked) and the host pipeline's argsort-based
+    masking would emit a narrower batch than the synthetic pipeline,
+    crashing downstream with an opaque broadcast error (ADVICE r2 #1)."""
     if value >= 0:
-        return value if objective == "mlm" else 0
+        return min(value, seq_len) if objective == "mlm" else 0
     return int(round(0.15 * seq_len)) if objective == "mlm" else 0
 
 

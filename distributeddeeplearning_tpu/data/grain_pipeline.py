@@ -147,11 +147,17 @@ def build_grain_dataset(config: TrainConfig, *, train: bool,
         # shuffle-then-repeat: each epoch reshuffles deterministically
         # (reseed_each_epoch), matching the tf path's seeded shuffle.
         ds = ds.shuffle(seed=config.seed).repeat(None)
-        if start_step:
-            # Resume = index arithmetic; skipped records are never decoded.
-            ds = ds.slice(slice(start_step * per_process, None))
+    # random_map BEFORE the resume slice: grain keys each element's RNG by
+    # its index in the dataset it was mapped onto, so mapping first keys
+    # augmentation draws by GLOBAL stream position — a resumed run replays
+    # the exact crops/flips of the uninterrupted run, not just the same
+    # records (ADVICE r2 #2). MapDataset is lazy either way: the slice
+    # below still never decodes a skipped record.
     ds = ds.random_map(DecodeAndAugment(d.image_size, train,
                                         _np_dtype(config)))
+    if train and start_step:
+        # Resume = index arithmetic; skipped records are never decoded.
+        ds = ds.slice(slice(start_step * per_process, None))
     threads = max(os.cpu_count() or 8, 8)
     # Batch AFTER to_iter_dataset: prefetch threads then parallelize and
     # buffer individual decoded records (prefetch_buffer_size counts

@@ -83,15 +83,25 @@ class Checkpointer:
         from flax.core import meta
 
         # Sharding-metadata boxes (LogicallyPartitioned) serialize as
-        # single-key {'value': leaf} dicts; unwrap them.
-        def _is_box(n):
-            return (isinstance(n, dict) and set(n) == {"value"}
-                    and not isinstance(n["value"], dict))
-
-        tree = jax.tree_util.tree_map(
-            lambda n: n["value"] if _is_box(n) else n,
-            raw_subtree, is_leaf=_is_box)
+        # single-key {'value': leaf} dicts. Unwrap them by walking raw and
+        # target in parallel: a {'value': leaf} dict is a box only where the
+        # (unboxed) target tree has a LEAF at the same path — a model whose
+        # submodule legitimately names a parameter 'value' has a dict there
+        # in the target too, and is left alone (ADVICE r2 #3).
         like = meta.unbox(like)
+
+        def _unwrap(raw, ref):
+            if not isinstance(raw, dict):
+                return raw
+            if (set(raw) == {"value"} and not isinstance(raw["value"], dict)
+                    and not isinstance(ref, dict)):
+                return raw["value"]
+            if isinstance(ref, dict):
+                return {k: (_unwrap(v, ref[k]) if k in ref else v)
+                        for k, v in raw.items()}
+            return raw  # structure mismatch; the check below reports it
+
+        tree = _unwrap(raw_subtree, like)
         if (jax.tree_util.tree_structure(tree)
                 != jax.tree_util.tree_structure(like)):
             raise ValueError(

@@ -48,6 +48,7 @@ def _build(accum: int):
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.core
 def test_accum_matches_big_batch():
     rng = jax.random.key(1)
     batch = {
@@ -67,6 +68,7 @@ def test_accum_matches_big_batch():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_lars_32k_preset_runs_on_8_devices():
     from distributeddeeplearning_tpu.train import loop
 
@@ -101,6 +103,7 @@ def test_accum_gspmd_tokens_runs():
     assert np.isfinite(summary["final_metrics"]["loss"])
 
 
+@pytest.mark.core
 def test_accum_divisibility_validation():
     cfg = TrainConfig(global_batch_size=32, grad_accum_steps=3,
                       parallel=ParallelConfig(data=8))

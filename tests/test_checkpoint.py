@@ -149,6 +149,7 @@ def test_preemption_sigterm_saves_and_resumes(tmp_path):
     assert summary["start_step"] >= 2, summary
 
 
+@pytest.mark.core
 def test_preemption_resume_start_step(tmp_path, quiet):
     """In-process variant: a real SIGTERM delivered mid-run must trip the
     loop's preemption handler (SystemExit + synchronous save before any
@@ -201,3 +202,27 @@ def test_eval_only_restores_and_scores(tmp_path, quiet):
     assert summary["start_step"] == 3
     assert summary["final_step"] == 3
     assert 0.0 <= summary["eval_top1"] <= 1.0
+
+
+@pytest.mark.core
+def test_restore_unwraps_boxes_but_not_value_named_params():
+    # _restore_subtree must unwrap serialized sharding boxes ({'value': leaf}
+    # where the model has a leaf) while leaving a genuine parameter NAMED
+    # 'value' alone (ADVICE r2 #3) — the two shapes are identical in the raw
+    # checkpoint and only the target tree disambiguates them.
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    ck = Checkpointer.__new__(Checkpointer)
+    arr = jnp.arange(6.0).reshape(2, 3)
+    # Case 1: a submodule whose single param is named 'value' (dict in the
+    # target) — must survive round-trip un-unwrapped.
+    like = {"head": {"value": arr}}
+    raw = {"head": {"value": arr * 0 + 7.0}}
+    out = ck._restore_subtree(raw, like, "params")
+    assert set(out["head"]) == {"value"}
+    assert float(out["head"]["value"][0, 1]) == 7.0
+    # Case 2: a serialized box (leaf in the target) — must unwrap.
+    like2 = {"w": arr}
+    raw2 = {"w": {"value": arr * 0 + 3.0}}
+    out2 = ck._restore_subtree(raw2, like2, "params")
+    assert float(out2["w"][1, 2]) == 3.0

@@ -75,6 +75,7 @@ def grads_via(dp: int, batch, devices8):
     return jax.device_get(new_state.params), metrics
 
 
+@pytest.mark.core
 def test_dp8_matches_single_device(batch, devices8):
     """psum-averaged dp=8 step == single-device step on the full batch."""
     p1, m1 = grads_via(1, batch, devices8)
@@ -109,6 +110,7 @@ def test_dp_loss_decreases(devices8):
     assert int(state.step) == 10
 
 
+@pytest.mark.core
 def test_params_stay_replicated(batch, devices8):
     """After a dp step, params on every device must be identical (the
     Horovod broadcast+allreduce invariant)."""
@@ -127,6 +129,7 @@ def test_params_stay_replicated(batch, devices8):
         np.testing.assert_array_equal(shards[0], s)
 
 
+@pytest.mark.core
 def test_eval_psum_aggregation(devices8):
     model = tiny_model()
     cfg = cfg_for(8)

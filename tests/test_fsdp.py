@@ -10,6 +10,7 @@ equivalent of FSDP's explicit gather/scatter machinery.
 
 import jax
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributeddeeplearning_tpu.config import (
@@ -40,6 +41,7 @@ def build(parallel: ParallelConfig):
     return src, state, step
 
 
+@pytest.mark.core
 def test_fsdp_params_actually_shard(devices8):
     _, state, _ = build(ParallelConfig(data=2, fsdp=2, model=2))
     qk = state.params["layer0"]["attention"]["query"]["kernel"].value
@@ -56,6 +58,7 @@ def test_fsdp_params_actually_shard(devices8):
     assert mu_qk.sharding.spec == P("fsdp", "model"), mu_qk.sharding
 
 
+@pytest.mark.core
 def test_fsdp_matches_dp_numerics(devices8):
     """3 training steps under fsdp=2 == pure dp=8, same seed/batches."""
     losses = {}

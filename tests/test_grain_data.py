@@ -116,6 +116,22 @@ def test_resume_is_exact_slice(folder_dir):
     np.testing.assert_array_equal(np.stack(full[3:]), np.stack(resumed))
 
 
+@pytest.mark.usefixtures("devices8")
+def test_resume_replays_augmentation_draws(folder_dir):
+    # Stronger than record identity: the random crop/flip draws must also
+    # match the uninterrupted run — augmentation RNG is keyed by global
+    # stream index, not by position within the resumed slice (ADVICE r2 #2).
+    cfg = _cfg(folder_dir, batch=8, dp=1)
+
+    def images(start):
+        src = _source(cfg, train=True, start_step=start)
+        return [np.asarray(jax.device_get(src.batch(i)["image"]))
+                for i in range(start, 6)]
+
+    full, resumed = images(0), images(3)
+    np.testing.assert_array_equal(np.stack(full[3:]), np.stack(resumed))
+
+
 def test_process_sharding_disjoint(folder_dir):
     # One eval epoch, 2 processes: 8 val records -> one batch of 4 each;
     # interleaved index sharding must cover the split exactly once.

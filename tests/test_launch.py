@@ -15,6 +15,7 @@ import pytest
 from distributeddeeplearning_tpu import launch
 
 
+@pytest.mark.core
 def test_plan_local():
     specs = launch.plan_local(4, port=9100)
     assert [s.process_id for s in specs] == [0, 1, 2, 3]
@@ -25,6 +26,7 @@ def test_plan_local():
     assert env[launch.ENV_NUM_PROCESSES] == "4"
 
 
+@pytest.mark.core
 def test_plan_from_hostfile(tmp_path):
     hf = tmp_path / "hosts"
     hf.write_text("# slice hosts\nworker0\nworker1\n\nworker2\n")
@@ -42,11 +44,13 @@ def _spawn_py(code: str) -> subprocess.Popen:
     return subprocess.Popen([sys.executable, "-c", code])
 
 
+@pytest.mark.core
 def test_monitor_all_succeed():
     children = [_spawn_py("import sys; sys.exit(0)") for _ in range(3)]
     assert launch.monitor(children) == 0
 
 
+@pytest.mark.core
 def test_monitor_fail_whole():
     """First nonzero exit kills the survivors (mpirun semantics)."""
     slow = _spawn_py("import time; time.sleep(60)")
@@ -106,6 +110,7 @@ def test_fault_injection_then_resume(tmp_path):
     assert summary["final_step"] == 5
 
 
+@pytest.mark.slow
 def test_max_restarts_auto_resumes(tmp_path):
     """--max-restarts closes the §5.3 loop in-launcher: the injected crash
     triggers an automatic relaunch that resumes from the checkpoint and

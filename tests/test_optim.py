@@ -3,11 +3,14 @@ tensors, schedule shapes, linear-scaling rule, decay masking."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from distributeddeeplearning_tpu.config import OptimizerConfig
 from distributeddeeplearning_tpu.train import optim
 
+
+pytestmark = pytest.mark.core
 
 def test_linear_scaling_rule():
     cfg = OptimizerConfig(learning_rate=0.1, reference_batch=256)

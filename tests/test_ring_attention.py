@@ -19,6 +19,7 @@ from distributeddeeplearning_tpu.parallel import ring_attention as ring
 from tests.attention_refs import dense_reference, random_qkv
 
 
+@pytest.mark.core
 @pytest.mark.parametrize("seq_shards", [1, 2, 4, 8])
 def test_ring_matches_dense(seq_shards):
     q, k, v = random_qkv(jax.random.key(0))
@@ -31,6 +32,7 @@ def test_ring_matches_dense(seq_shards):
         rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.core
 def test_ring_respects_padding_mask():
     """Padding keys must not leak attention, wherever their shard lives."""
     q, k, v = random_qkv(jax.random.key(1))
@@ -62,6 +64,7 @@ def test_ring_composes_with_head_sharding():
         rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.core
 def test_ring_grads_match_dense():
     """Autodiff through the ppermute ring == autodiff through dense attn."""
     q, k, v = random_qkv(jax.random.key(3), s=16)
@@ -121,6 +124,7 @@ def test_bert_ring_matches_dense_forward():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.core
 @pytest.mark.parametrize("seq_shards", [1, 2, 4])
 def test_causal_ring_matches_causal_dense(seq_shards):
     """Causal ring == causal dense attention, incl. a padding mask and

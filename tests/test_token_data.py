@@ -82,3 +82,23 @@ def test_bert_end_to_end_real_tokens(token_dir):
     summary = loop.run(cfg, total_steps=3)
     assert summary["final_step"] == 3
     assert np.isfinite(summary["final_metrics"]["loss"])
+
+
+@pytest.mark.core
+def test_mlm_max_predictions_clamped_to_seq_len():
+    # An explicit width beyond seq_len is meaningless and used to crash the
+    # host pipeline with an opaque broadcast error while the synthetic path
+    # silently narrowed (ADVICE r2 #1); both now see the clamped width.
+    from distributeddeeplearning_tpu.config import resolve_mlm_max_predictions
+
+    assert resolve_mlm_max_predictions(4096, 128, "mlm") == 128
+    assert resolve_mlm_max_predictions(20, 128, "mlm") == 20
+    assert resolve_mlm_max_predictions(-1, 128, "mlm") == 19
+    assert resolve_mlm_max_predictions(4096, 128, "causal") == 0
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 100, (4, 16)).astype(np.int32)
+    out = tokens.gather_mask_batch(
+        ids, max_pred=resolve_mlm_max_predictions(64, 16, "mlm"),
+        mask_prob=0.15, vocab_size=100, rng=rng)
+    assert out["masked_positions"].shape == (4, 16)

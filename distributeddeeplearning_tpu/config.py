@@ -28,6 +28,11 @@ class ParallelConfig:
     seq: int = 1        # sp/cp: sequence-dim sharding (ring attention)
     expert: int = 1     # ep: MoE expert sharding (models/moe.py)
     pipeline: int = 1   # pp: GPipe pipeline stages (models/pipeline.py)
+    # Validation-only: emulate an N-slice pod's hybrid ICI/DCN device layout
+    # on non-TPU platforms (tests / dryrun_multichip), exercising the same
+    # _hybrid_shapes axis split a real multi-slice mesh gets. 0/1 = off.
+    # On real TPU the slice count is auto-detected and this knob is ignored.
+    emulate_slices: int = 0
 
     @property
     def num_devices(self) -> int:

@@ -68,6 +68,18 @@ def make_mesh(parallel: ParallelConfig,
         else:
             dev_array = mesh_utils.create_device_mesh(
                 shape, devices=list(devices))
+    elif parallel.emulate_slices > 1:
+        # Emulated multi-slice layout (validation): treat device blocks of
+        # size n/num_slices as slices and arrange each global axis
+        # DCN-major / per-slice-minor — the same arrangement
+        # create_hybrid_device_mesh produces on a real pod, so the sharding
+        # rules and collectives compile against the hybrid layout without
+        # multi-slice hardware.
+        per_slice, dcn = _hybrid_shapes(shape, parallel.emulate_slices)
+        k = len(shape)
+        arr = np.asarray(list(devices)).reshape(tuple(dcn) + tuple(per_slice))
+        perm = [x for i in range(k) for x in (i, k + i)]
+        dev_array = arr.transpose(perm).reshape(shape)
     else:
         dev_array = np.asarray(list(devices)).reshape(shape)
     return Mesh(dev_array, MESH_AXES)

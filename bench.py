@@ -114,6 +114,8 @@ def _protocol_suffix(args) -> str:
         parts.append(args.attention_impl)
     if args.remat:
         parts.append("remat")
+    if getattr(args, "fused_bn", False):
+        parts.append("fusedbn")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -166,6 +168,7 @@ def _child_measure(args, emit_quick: bool = True) -> None:
         log_every=10**9,  # silent; bench prints only metric lines on stdout
         attention_impl=args.attention_impl,
         remat=args.remat,
+        fused_bn=args.fused_bn,
         parallel=ParallelConfig(data=n_dev),
         data=data)
 
@@ -238,7 +241,7 @@ def _child(args) -> int:
     for model, overrides in SUITE:
         row = copy.copy(args)
         row.model = model
-        row.attention_impl, row.remat = None, False
+        row.attention_impl, row.remat, row.fused_bn = None, False, False
         for k, v in overrides.items():
             setattr(row, k, v)
         try:
@@ -344,6 +347,8 @@ def main(argv=None) -> int:
                    help="attention implementation for token models")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer layers in backward")
+    p.add_argument("--fused-bn", action="store_true",
+                   help="Pallas fused BN(+residual)+ReLU kernels (CNNs)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -389,6 +394,8 @@ def main(argv=None) -> int:
         child_cmd += ["--attention-impl", args.attention_impl]
     if args.remat:
         child_cmd += ["--remat"]
+    if args.fused_bn:
+        child_cmd += ["--fused-bn"]
     if args.suite:
         child_cmd += ["--suite"]
         args.attempt_timeout = max(args.attempt_timeout, args.budget)

@@ -133,6 +133,7 @@ class TrainConfig:
     attention_impl: Optional[str] = None  # None=default; dense|ring|flash
     remat: bool = False           # recompute transformer-layer activations
                                   # in backward (less HBM, ~1/3 more FLOPs)
+    fused_bn: bool = False        # Pallas fused BN+ReLU kernels (CNNs)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

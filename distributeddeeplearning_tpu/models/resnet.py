@@ -32,31 +32,30 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int
     conv: ModuleDef
-    norm: ModuleDef
-    act: Callable
+    norm_act: Callable
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
-        y = self.norm(name="bn1")(y)
-        y = self.act(y)
+        y = self.norm_act(y, name="bn1")
         # v1.5: stride lives on the 3x3, not the first 1x1. Explicit (1,1)
         # padding: XLA's SAME pads (0,1) at stride 2, torch pads (1,1) —
         # symmetric keeps us numerically identical to the reference-era
         # torch trainers (tests/test_torch_parity.py).
         y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                       padding=[(1, 1), (1, 1)], name="conv2")(y)
-        y = self.norm(name="bn2")(y)
-        y = self.act(y)
+        y = self.norm_act(y, name="bn2")
         y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
-        y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters * 4 or self.strides != 1:
             residual = self.conv(self.filters * 4, (1, 1),
                                  strides=(self.strides, self.strides),
                                  name="downsample_conv")(x)
-            residual = self.norm(name="downsample_bn")(residual)
-        return self.act(residual + y)
+            residual = self.norm_act(residual, name="downsample_bn",
+                                     relu=False)
+        # Block exit: BN + residual add + ReLU in one fused pass.
+        return self.norm_act(y, name="bn3", residual=residual,
+                             scale_init=nn.initializers.zeros)
 
 
 class BasicBlock(nn.Module):
@@ -65,24 +64,23 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int
     conv: ModuleDef
-    norm: ModuleDef
-    act: Callable
+    norm_act: Callable
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                       padding=[(1, 1), (1, 1)], name="conv1")(x)
-        y = self.norm(name="bn1")(y)
-        y = self.act(y)
+        y = self.norm_act(y, name="bn1")
         y = self.conv(self.filters, (3, 3), name="conv2")(y)
-        y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.filters or self.strides != 1:
             residual = self.conv(self.filters, (1, 1),
                                  strides=(self.strides, self.strides),
                                  name="downsample_conv")(x)
-            residual = self.norm(name="downsample_bn")(residual)
-        return self.act(residual + y)
+            residual = self.norm_act(residual, name="downsample_bn",
+                                     relu=False)
+        return self.norm_act(y, name="bn2", residual=residual,
+                             scale_init=nn.initializers.zeros)
 
 
 class ResNet(nn.Module):
@@ -93,6 +91,11 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    # Pallas fused BN(+residual)+ReLU kernels (ops/fused_batchnorm.py) for
+    # the BN bandwidth tax (BASELINE.md profile: 113 ms of a 209 ms batch-512
+    # step in BN-statistics/dγ/dβ/dx reductions). Same variable layout and
+    # numerics as the unfused path; off by default until measured on-chip.
+    fused_bn: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -101,24 +104,39 @@ class ResNet(nn.Module):
             kernel_init=nn.initializers.variance_scaling(
                 2.0, "fan_out", "normal"),
             padding="SAME")
-        norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
-        act = nn.relu
+
+        def norm_act(y, *, name, residual=None, relu=True,
+                     scale_init=nn.initializers.ones):
+            """BN [+ residual add] [+ ReLU] — one fused Pallas pass when
+            ``fused_bn``, the classic composition otherwise. Both create
+            identical variables under ``name``."""
+            if self.fused_bn:
+                from distributeddeeplearning_tpu.ops.fused_batchnorm import (
+                    FusedBatchNormAct)
+                return FusedBatchNormAct(
+                    use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                    dtype=self.dtype, relu=relu, scale_init=scale_init,
+                    name=name)(y, residual=residual)
+            y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             param_dtype=jnp.float32, scale_init=scale_init,
+                             name=name)(y)
+            if residual is not None:
+                y = y + residual
+            return nn.relu(y) if relu else y
 
         x = jnp.asarray(x, self.dtype)
         # Explicit (3,3): torch's symmetric stem padding (SAME would pad
         # (2,3) on 224 at stride 2 — a one-pixel shift vs the reference).
         x = conv(self.width, (7, 7), strides=(2, 2),
                  padding=[(3, 3), (3, 3)], name="conv_stem")(x)
-        x = norm(name="bn_stem")(x)
-        x = act(x)
+        x = norm_act(x, name="bn_stem")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = self.block(filters=self.width * 2 ** i, strides=strides,
-                               conv=conv, norm=norm, act=act,
+                               conv=conv, norm_act=norm_act,
                                name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
@@ -129,21 +147,40 @@ class ResNet(nn.Module):
         return jnp.asarray(x, jnp.float32)
 
 
-def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype)
+def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            fused_bn: bool = False) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype,
+                  fused_bn=fused_bn)
 
 
-def resnet34(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype)
+def resnet18_thin(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+                  fused_bn: bool = False) -> ResNet:
+    """Width-16 ResNet-18 (1/16th the conv FLOPs): the CPU-tractable stand-in
+    for convergence-recipe demonstrations (tools/convergence_lars.py) and
+    fast tests — same depth, blocks, and BN structure as the real thing."""
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, width=16,
+                  dtype=dtype, fused_bn=fused_bn)
 
 
-def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype)
+def resnet34(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            fused_bn: bool = False) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype,
+                  fused_bn=fused_bn)
 
 
-def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype)
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            fused_bn: bool = False) -> ResNet:
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype,
+                  fused_bn=fused_bn)
 
 
-def resnet152(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype=dtype)
+def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            fused_bn: bool = False) -> ResNet:
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype,
+                  fused_bn=fused_bn)
+
+
+def resnet152(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            fused_bn: bool = False) -> ResNet:
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype=dtype,
+                  fused_bn=fused_bn)

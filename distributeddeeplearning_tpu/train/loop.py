@@ -79,6 +79,8 @@ def build(config: TrainConfig, total_steps: int):
         kw["attention_impl"] = config.attention_impl
     if config.remat:
         kw["remat"] = True
+    if config.fused_bn:
+        kw["fused_bn"] = True
     model = spec.build(**kw)
 
     # A mesh axis nothing maps onto silently duplicates compute across its

@@ -1,0 +1,208 @@
+"""Fused BN(+residual)+ReLU kernels (ops/fused_batchnorm.py) vs the classic
+flax composition — forward, gradients, running-stat updates, and the
+end-to-end resnet fused_bn flag. Kernels run in Pallas interpret mode here
+(CPU); tools/validate_flash_tpu.py-style on-chip validation covers compiled
+behavior (tools/validate_fused_bn_tpu.py)."""
+
+import flax.linen as nn
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.ops import fused_batchnorm as fbn
+
+EPS = 1e-5
+
+
+def _ref_bn_act(x2d, gamma, beta, residual=None, relu=True):
+    """The unfused composition: batch-stats BN -> +residual -> relu, f32."""
+    mean = x2d.mean(axis=0)
+    var = ((x2d - mean) ** 2).mean(axis=0)
+    y = (x2d - mean) * jax.lax.rsqrt(var + EPS) * gamma + beta
+    if residual is not None:
+        y = y + residual
+    return jnp.maximum(y, 0.0) if relu else y
+
+
+@pytest.mark.core
+def test_stats_kernel_matches_jnp():
+    x = jax.random.normal(jax.random.key(0), (192, 96), jnp.float32)
+    mean, var = fbn.bn_stats(x)
+    np.testing.assert_allclose(mean, x.mean(0), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(var, x.var(0), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("relu", [True, False])
+def test_forward_matches_reference(relu):
+    k = jax.random.key(1)
+    x = jax.random.normal(k, (64, 32), jnp.float32)
+    gamma = jax.random.normal(jax.random.key(2), (32,)) * 0.2 + 1.0
+    beta = jax.random.normal(jax.random.key(3), (32,)) * 0.1
+    y, mean, var = fbn.bn_act_train(x, gamma, beta, relu, EPS)
+    np.testing.assert_allclose(y, _ref_bn_act(x, gamma, beta, relu=relu),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(mean, x.mean(0), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.core
+def test_gradients_match_reference():
+    k = jax.random.key(4)
+    x = jax.random.normal(k, (48, 24), jnp.float32)
+    gamma = jax.random.normal(jax.random.key(5), (24,)) * 0.3 + 1.0
+    beta = jax.random.normal(jax.random.key(6), (24,)) * 0.1
+    w = jax.random.normal(jax.random.key(7), (48, 24))
+
+    def loss_fused(x, g, b):
+        y, _, _ = fbn.bn_act_train(x, g, b, True, EPS)
+        return jnp.sum(y * w)
+
+    def loss_ref(x, g, b):
+        return jnp.sum(_ref_bn_act(x, g, b) * w)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_, name in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(a, b_, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+
+@pytest.mark.core
+def test_residual_variant_gradients():
+    x = jax.random.normal(jax.random.key(8), (32, 16), jnp.float32)
+    res = jax.random.normal(jax.random.key(9), (32, 16), jnp.float32)
+    gamma = jnp.ones((16,)) * 1.3
+    beta = jnp.zeros((16,)) + 0.05
+    w = jax.random.normal(jax.random.key(10), (32, 16))
+
+    def loss_fused(x, g, b, r):
+        y, _, _ = fbn.bn_act_res_train(x, g, b, r, True, EPS)
+        return jnp.sum(y * w)
+
+    def loss_ref(x, g, b, r):
+        return jnp.sum(_ref_bn_act(x, g, b, residual=r) * w)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b_, name in zip(gf, gr, ("dx", "dgamma", "dbeta", "dres")):
+        np.testing.assert_allclose(a, b_, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+
+@pytest.mark.core
+def test_module_matches_flax_batchnorm():
+    """Same input -> same output, same running-stat update as nn.BatchNorm
+    followed by relu; identical variable tree."""
+    x = jax.random.normal(jax.random.key(11), (4, 8, 8, 16), jnp.float32)
+
+    fused = fbn.FusedBatchNormAct(dtype=jnp.float32)
+    vf = fused.init(jax.random.key(0), x)
+
+    class Classic(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            y = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                             epsilon=EPS, dtype=jnp.float32,
+                             param_dtype=jnp.float32, name="bn")(x)
+            return nn.relu(y)
+
+    classic = Classic()
+    vc = classic.init(jax.random.key(0), x)
+    yf, mf = fused.apply(vf, x, mutable=["batch_stats"])
+    yc, mc = classic.apply(vc, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(yf, yc, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(mf["batch_stats"]["mean"],
+                               mc["batch_stats"]["bn"]["mean"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mf["batch_stats"]["var"],
+                               mc["batch_stats"]["bn"]["var"],
+                               rtol=1e-5, atol=1e-6)
+    # Inference mode consumes the updated stats identically.
+    vf2 = {"params": vf["params"], "batch_stats": mf["batch_stats"]}
+    vc2 = {"params": vc["params"], "batch_stats": mc["batch_stats"]}
+    yf2 = fbn.FusedBatchNormAct(
+        use_running_average=True, dtype=jnp.float32).apply(vf2, x)
+    yc2 = nn.relu(nn.BatchNorm(use_running_average=True, momentum=0.9,
+                               epsilon=EPS, dtype=jnp.float32,
+                               name="bn").apply(
+        {"params": vc2["params"]["bn"],
+         "batch_stats": vc2["batch_stats"]["bn"]}, x))
+    np.testing.assert_allclose(yf2, yc2, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.core
+def test_resnet_fused_flag_preserves_numerics_and_tree():
+    """resnet18_thin with fused_bn=True: identical variable tree, matching
+    logits and end-to-end gradients vs the unfused model."""
+    from distributeddeeplearning_tpu.models import resnet
+
+    x = jax.random.normal(jax.random.key(12), (8, 32, 32, 3), jnp.float32)
+    labels = jnp.arange(8) % 10
+    models = {
+        flag: resnet.resnet18_thin(num_classes=10, dtype=jnp.float32,
+                                   fused_bn=flag)
+        for flag in (False, True)
+    }
+    variables = {flag: m.init({"params": jax.random.key(0)}, x, train=False)
+                 for flag, m in models.items()}
+    assert (jax.tree_util.tree_structure(variables[False])
+            == jax.tree_util.tree_structure(variables[True]))
+    for a, b in zip(jax.tree_util.tree_leaves(variables[False]),
+                    jax.tree_util.tree_leaves(variables[True])):
+        np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+    def loss_fn(flag, params):
+        v = {"params": params, "batch_stats": variables[flag]["batch_stats"]}
+        logits, _ = models[flag].apply(v, x, train=True,
+                                       mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, 10)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+
+    losses, grads = {}, {}
+    for flag in (False, True):
+        losses[flag], grads[flag] = jax.value_and_grad(
+            lambda p, f=flag: loss_fn(f, p))(variables[flag]["params"])
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-4, atol=1e-4)
+    flat_f, _ = jax.flatten_util.ravel_pytree(grads[True])
+    flat_r, _ = jax.flatten_util.ravel_pytree(grads[False])
+    np.testing.assert_allclose(flat_f, flat_r, rtol=5e-3, atol=5e-4)
+
+
+def test_bfloat16_path_runs():
+    x = jax.random.normal(jax.random.key(13), (4, 8, 8, 32), jnp.bfloat16)
+    m = fbn.FusedBatchNormAct(dtype=jnp.bfloat16)
+    v = m.init(jax.random.key(0), x)
+    y, _ = m.apply(v, x, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16 and y.shape == x.shape
+
+
+@pytest.mark.core
+@pytest.mark.usefixtures("devices8")
+def test_fused_dp_step_matches_unfused():
+    """Two DP train steps over the 8-device mesh: fused_bn on/off produce
+    the same loss trajectory (the shard_map/check_vma integration path)."""
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu import data as datalib
+    from distributeddeeplearning_tpu.train import loop
+
+    losses = {}
+    for fused in (False, True):
+        cfg = TrainConfig(
+            model="resnet18_thin", global_batch_size=32, dtype="float32",
+            log_every=10**9, fused_bn=fused,
+            parallel=ParallelConfig(data=8),
+            data=DataConfig(synthetic=True, image_size=32, num_classes=10,
+                            synthetic_learnable=True))
+        mesh, model, batch_shd, state, train_step, _, rng = loop.build(cfg, 2)
+        src = datalib.make_source(cfg, "image", batch_shd)
+        out = []
+        for i in range(2):
+            state, metrics = train_step(state, src.batch(i), rng)
+            out.append(float(metrics["loss"]))
+        losses[fused] = out
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-5)

@@ -61,6 +61,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--remat", action="store_true", default=None,
                    help="rematerialize transformer layers in backward "
                         "(less activation HBM, ~1/3 more FLOPs)")
+    p.add_argument("--fused-bn", action="store_true", default=None,
+                   help="Pallas fused BN(+residual)+ReLU kernels for CNNs "
+                        "(ops/fused_batchnorm.py)")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")
     p.add_argument("--mlm-max-predictions", type=int, default=None,
@@ -174,6 +177,8 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(attention_impl=args.attn)
     if args.remat:
         cfg = cfg.replace(remat=True)
+    if args.fused_bn:
+        cfg = cfg.replace(fused_bn=True)
 
     data_updates = {}
     if args.synthetic is not None:

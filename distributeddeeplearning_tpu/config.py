@@ -134,6 +134,10 @@ class TrainConfig:
     remat: bool = False           # recompute transformer-layer activations
                                   # in backward (less HBM, ~1/3 more FLOPs)
     fused_bn: bool = False        # Pallas fused BN+ReLU kernels (CNNs)
+    # GPipe microbatch count for *_pp models (None = model default). The
+    # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
+    # under ~20% (tools/bench_parallel_overhead.py measures this).
+    pipeline_microbatches: Optional[int] = None
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

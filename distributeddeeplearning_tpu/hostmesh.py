@@ -1,0 +1,28 @@
+"""Virtual-CPU-mesh pinning for tools, tests, and driver entry points.
+
+The container's axon sitecustomize imports jax at interpreter start and
+pins ``jax_platforms`` at a remote TPU whose backend init can hang, so env
+vars alone are too late — callers that must never touch real hardware
+(dryrun_multichip, CPU-only tools) pin through jax.config as well. This
+module itself imports nothing heavy (the package root pulls in only
+config.py), so it is safe to call before jax's first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_virtual_cpu_mesh(n_devices: int = 8) -> None:
+    """Force an ``n_devices`` virtual-CPU platform before any backend init."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -83,12 +83,14 @@ def _registry() -> dict[str, ModelSpec]:
         "gpt2_small_pp": ModelSpec(
             name="gpt2_small_pp", objective="causal",
             build=lambda **kw: gpt.gpt2_small(
-                pipeline_stages=4, pipeline_microbatches=8, **kw),
+                **{"pipeline_stages": 4,
+                   "pipeline_microbatches": 8, **kw}),
             input_kind="tokens", param_count=0),
         "gpt_tiny_pp": ModelSpec(
             name="gpt_tiny_pp", objective="causal",
             build=lambda **kw: gpt.tiny_gpt(
-                pipeline_stages=2, pipeline_microbatches=4, **kw),
+                **{"pipeline_stages": 2,
+                   "pipeline_microbatches": 4, **kw}),
             input_kind="tokens", param_count=0),
         # BERT-base with a top-1-routed 8-expert MoE FFN every other layer
         # (models/moe.py), expert-parallel over the `expert` mesh axis.
@@ -108,12 +110,14 @@ def _registry() -> dict[str, ModelSpec]:
         "bert_base_pp": ModelSpec(
             name="bert_base_pp", objective="mlm",
             build=lambda **kw: bert.bert_base_mlm(
-                pipeline_stages=4, pipeline_microbatches=8, **kw),
+                **{"pipeline_stages": 4,
+                   "pipeline_microbatches": 8, **kw}),
             input_kind="tokens", param_count=0),
         "bert_tiny_pp": ModelSpec(
             name="bert_tiny_pp", objective="mlm",
             build=lambda **kw: bert.tiny_bert_mlm(
-                pipeline_stages=2, pipeline_microbatches=4, **kw),
+                **{"pipeline_stages": 2,
+                   "pipeline_microbatches": 4, **kw}),
             input_kind="tokens", param_count=0),
     }
 

@@ -81,6 +81,8 @@ def build(config: TrainConfig, total_steps: int):
         kw["remat"] = True
     if config.fused_bn:
         kw["fused_bn"] = True
+    if config.pipeline_microbatches:
+        kw["pipeline_microbatches"] = config.pipeline_microbatches
     model = spec.build(**kw)
 
     # A mesh axis nothing maps onto silently duplicates compute across its
@@ -89,6 +91,18 @@ def build(config: TrainConfig, total_steps: int):
     mcfg = getattr(model, "cfg", None)
     stages = getattr(mcfg, "pipeline_stages", 1)
     experts = getattr(mcfg, "num_experts", 0)
+    if config.pipeline_microbatches is not None:
+        if config.pipeline_microbatches < 1:
+            raise ValueError(
+                f"pipeline_microbatches={config.pipeline_microbatches} "
+                f"must be >= 1")
+        if stages <= 1:
+            # Same loud-reject rule as the CNN builders for attn/remat:
+            # a knob nothing consumes must not silently do nothing.
+            raise ValueError(
+                f"pipeline_microbatches set but model {config.model!r} is "
+                f"not pipelined (pipeline_stages={stages}); use a *_pp "
+                f"model")
     if config.parallel.pipeline > 1 and stages % config.parallel.pipeline:
         raise ValueError(
             f"parallel.pipeline={config.parallel.pipeline} but model "

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Characterize the overhead of each parallel style on the fake-CPU mesh.
+
+VERDICT r2 Weak #5/#8: GPipe's one-program schedule runs every stage every
+tick (fill/drain ticks included) and MoE's GShard-style dispatch
+materializes (B,S,E,C) tensors — correctness is proven by tests, but
+nothing bounded their cost. This tool measures it.
+
+Method: the 8-virtual-device CPU mesh serializes device programs onto host
+cores, so wall-clock per step ~ TOTAL compute issued across the mesh.
+That makes it exactly the right instrument for *occupancy* overheads (the
+bubble's wasted stage-ticks, the dispatch einsums, FSDP's all-gather
+regather work) even though absolute numbers say nothing about chip
+latency. Expectations:
+
+- pipeline: useful-work fraction is M/(M+P-1); measured step time should
+  scale ~ (M+P-1)/M at fixed global batch. Choose M >= 4·(P-1) to keep
+  the bubble under ~20%.
+- MoE vs dense FFN: ratio above the FLOP ratio is dispatch overhead.
+- dp x fsdp / dp x tp vs pure dp: ratio above 1.0 is regather overhead.
+
+Prints one JSON line per experiment. Run on an OTHERWISE IDLE host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pin_cpu_mesh(n: int = 8) -> None:
+    from distributeddeeplearning_tpu.hostmesh import pin_virtual_cpu_mesh
+
+    pin_virtual_cpu_mesh(n)
+
+
+def time_config(model_name: str, parallel_kw: dict, *, batch: int,
+                seq_len: int = 64, steps: int = 4,
+                microbatches=None) -> float:
+    """Seconds per train step for a config on the fake mesh."""
+    import jax
+
+    from distributeddeeplearning_tpu import data as datalib
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = TrainConfig(
+        model=model_name, global_batch_size=batch, dtype="float32",
+        log_every=10**9, parallel=ParallelConfig(**parallel_kw),
+        pipeline_microbatches=microbatches,
+        data=DataConfig(dataset="mlm", seq_len=seq_len, vocab_size=512),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=1e-4,
+                                  schedule="linear", label_smoothing=0.0))
+    mesh, model, batch_shd, state, train_step, _, rng = loop.build(cfg, steps)
+    src = datalib.make_source(cfg, "tokens", batch_shd, objective="mlm")
+    state, metrics = train_step(state, src.batch(0), rng)
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for i in range(1, steps + 1):
+        state, metrics = train_step(state, src.batch(i), rng)
+    jax.device_get(metrics)
+    return (time.perf_counter() - t0) / steps
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--steps", type=int, default=4)
+    args = p.parse_args(argv)
+    _pin_cpu_mesh()
+
+    # --- baseline: plain dp8 on bert_tiny --------------------------------
+    base = time_config("bert_tiny", {"data": 8}, batch=args.batch,
+                       seq_len=args.seq_len, steps=args.steps)
+    print(json.dumps({"experiment": "dp8_baseline_s_per_step",
+                      "s": round(base, 3)}), flush=True)
+
+    # --- fsdp / tp vs dp -------------------------------------------------
+    for name, par in (("dp4_fsdp2", {"data": 4, "fsdp": 2}),
+                      ("dp4_tp2", {"data": 4, "model": 2}),
+                      ("dp2_sp2_tp2", {"data": 2, "seq": 2, "model": 2})):
+        t = time_config("bert_tiny", par, batch=args.batch,
+                        seq_len=args.seq_len, steps=args.steps)
+        print(json.dumps({"experiment": name, "s": round(t, 3),
+                          "vs_dp8": round(t / base, 2)}), flush=True)
+
+    # --- pipeline bubble vs microbatch count -----------------------------
+    for m in (2, 4, 8, 16):
+        if args.batch % m:
+            continue
+        t = time_config("bert_tiny_pp", {"pipeline": 2, "data": 4},
+                        batch=args.batch, seq_len=args.seq_len,
+                        steps=args.steps, microbatches=m)
+        ticks = m + 2 - 1
+        print(json.dumps({
+            "experiment": f"pp2_m{m}", "s": round(t, 3),
+            "vs_dp8": round(t / base, 2),
+            "schedule_overhead_model": round(ticks / m, 2)}), flush=True)
+
+    # --- MoE vs dense FFN ------------------------------------------------
+    t = time_config("bert_tiny_moe", {"data": 4, "expert": 2},
+                    batch=args.batch, seq_len=args.seq_len, steps=args.steps)
+    print(json.dumps({"experiment": "moe_e4_dp4_ep2", "s": round(t, 3),
+                      "vs_dp8": round(t / base, 2)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

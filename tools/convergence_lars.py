@@ -42,17 +42,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _pin_cpu_mesh(n: int = 8) -> None:
-    import re
+    from distributeddeeplearning_tpu.hostmesh import pin_virtual_cpu_mesh
 
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={n}").strip()
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    pin_virtual_cpu_mesh(n)
 
 
 def main(argv=None) -> int:

@@ -64,6 +64,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fused-bn", action="store_true", default=None,
                    help="Pallas fused BN(+residual)+ReLU kernels for CNNs "
                         "(ops/fused_batchnorm.py)")
+    p.add_argument("--pp-microbatches", type=int, default=None,
+                   help="GPipe microbatch count for *_pp models; the fill/"
+                        "drain bubble wastes (P-1)/(M+P-1) of each step, so "
+                        "use M >= 4*(P-1)")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")
     p.add_argument("--mlm-max-predictions", type=int, default=None,
@@ -179,6 +183,8 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(remat=True)
     if args.fused_bn:
         cfg = cfg.replace(fused_bn=True)
+    if args.pp_microbatches:
+        cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
 
     data_updates = {}
     if args.synthetic is not None:

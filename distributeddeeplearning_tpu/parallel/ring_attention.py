@@ -160,6 +160,156 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
     return mapped(q, k, v, kv_mask)
 
 
+# ---------------------------------------------------------------------------
+# Zigzag (load-balanced) causal ring — the latency fix the plain causal
+# ring cannot deliver (BASELINE.md r3 note): with contiguous sharding the
+# last shard computes every block, so the lockstep ring's critical path is
+# unchanged by skipping work elsewhere. Zigzag sharding gives shard i the
+# chunk PAIR (i, 2n-1-i) of 2n global chunks — one early (light) and one
+# late (heavy) — which makes every shard's causal work equal by
+# construction: per ring arrival, each shard folds exactly two chunk-pair
+# updates (three on the local step), so the critical path drops from n
+# full-block updates to ~n single-chunk pairs (~2x at equal total FLOPs).
+# ---------------------------------------------------------------------------
+
+def zigzag_indices(seq_len: int, n_shards: int):
+    """Permutation taking the natural sequence to zigzag-shard order.
+
+    ``x[:, perm]`` lays the sequence out so an even split over ``n_shards``
+    gives shard i the chunks (i, 2n-1-i); ``inv`` undoes it
+    (``y[:, inv]`` returns to natural order).
+    """
+    import numpy as np
+
+    assert seq_len % (2 * n_shards) == 0, (seq_len, n_shards)
+    c = seq_len // (2 * n_shards)
+    chunks = np.arange(seq_len).reshape(2 * n_shards, c)
+    perm = np.concatenate([
+        np.concatenate([chunks[i], chunks[2 * n_shards - 1 - i]])
+        for i in range(n_shards)])
+    inv = np.argsort(perm)
+    return perm, inv
+
+
+def _zigzag_pairs(i: int, src: int, n: int):
+    """Pure-python mirror of the traced schedule: the (q_chunk, kv_chunk)
+    pairs shard ``i`` computes when shard ``src``'s K/V arrives. The
+    schedule-balance test sums this statically; the traced code below uses
+    the same predicates."""
+    qlo, qhi = i, 2 * n - 1 - i
+    klo, khi = src, 2 * n - 1 - src
+    pairs = []
+    if klo <= qlo:
+        pairs.append((qlo, klo))
+    if khi <= qlo:  # provably never (khi >= n > qlo); kept for the mirror
+        pairs.append((qlo, khi))
+    if klo <= qhi:  # provably always (klo < n <= qhi)
+        pairs.append((qhi, klo))
+    if khi <= qhi:
+        pairs.append((qhi, khi))
+    return pairs
+
+
+def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
+    """Causal ring attention over zigzag-sharded sequences.
+
+    Call under ``shard_map`` with inputs already in zigzag layout
+    (:func:`zigzag_indices`): per shard, the local (B, S_local, H, D)
+    arrays are ``concat(chunk_i, chunk_{2n-1-i})``. Output is in the same
+    local layout (undo globally with ``inv``). Numerics are exactly causal
+    attention in natural order (tests assert vs the dense reference).
+    """
+    b, sl, h, d = q.shape
+    c = sl // 2
+    scale = d ** -0.5
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    kv_mask = kv_mask.astype(jnp.bool_)
+
+    def halves(x):
+        return x[:, :c], x[:, c:]
+
+    def init():
+        return (jnp.full((b, h, c), _NEG, jnp.float32),
+                jnp.zeros((b, h, c), jnp.float32),
+                jnp.zeros((b, h, c, d), jnp.float32))
+
+    qlo, qhi = halves(q)
+    qlo_c, qhi_c = idx, 2 * n - 1 - idx  # global chunk indices
+
+    def fold(state, qh, qc, kh, kc, msk, tri: bool):
+        mask = block_causal_mask(qc, kc, c, c) if tri else None
+        return _block_update(qh, kh[0], kh[1], msk, *state, scale, mask)
+
+    # Local arrival (src == idx): seeds the carries with varying-type values
+    # (see the non-zigzag ring above) and leaves n-1 permutes in the ring.
+    klo, khi = halves(k)
+    vlo, vhi = halves(v)
+    mlo, mhi = halves(kv_mask)
+    lo = fold(init(), qlo, qlo_c, (klo, vlo), qlo_c, mlo, tri=True)
+    hi = fold(init(), qhi, qhi_c, (klo, vlo), qlo_c, mlo, tri=False)
+    hi = fold(hi, qhi, qhi_c, (khi, vhi), qhi_c, mhi, tri=True)
+
+    if n > 1:
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def body(r, carry):
+            lo, hi, k, v, msk = carry
+            k, v, msk = lax.ppermute((k, v, msk), axis_name, perm)
+            src = (idx - r) % n
+            klo, khi = halves(k)
+            vlo, vhi = halves(v)
+            mlo, mhi = halves(msk)
+            # Arriving chunk pair (src, 2n-1-src); every computed pair is a
+            # FULL block (strict chunk inequality — the only triangles are
+            # the local ones above), so tri=False throughout. The two conds
+            # mirror _zigzag_pairs: each shard folds exactly two of the
+            # three candidate pairs per arrival — balanced by construction.
+            lo = lax.cond(
+                src < idx,
+                lambda s: fold(s, qlo, qlo_c, (klo, vlo), src, mlo,
+                               tri=False),
+                lambda s: s, lo)
+            hi = fold(hi, qhi, qhi_c, (klo, vlo), src, mlo, tri=False)
+            hi = lax.cond(
+                src > idx,
+                lambda s: fold(s, qhi, qhi_c, (khi, vhi), 2 * n - 1 - src,
+                               mhi, tri=False),
+                lambda s: s, hi)
+            return lo, hi, k, v, msk
+
+        lo, hi, *_ = lax.fori_loop(1, n, body, (lo, hi, k, v, kv_mask))
+
+    def finish(state):
+        m, l, acc = state
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.transpose(0, 2, 1, 3)
+
+    return jnp.concatenate([finish(lo), finish(hi)], axis=1).astype(q.dtype)
+
+
+def zigzag_ring_attention_sharded(q, k, v, kv_mask, *,
+                                  mesh: Optional[jax.sharding.Mesh] = None,
+                                  seq_axis: str = "seq",
+                                  batch_axes=("data", "fsdp"),
+                                  head_axis: str = "model"):
+    """GSPMD-embeddable wrapper for :func:`zigzag_ring_attention` — same
+    contract as :func:`ring_attention_sharded`, inputs/outputs in zigzag
+    layout."""
+    if mesh is None:
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is None or ambient.empty:
+            return _local_attention(q, k, v, kv_mask, causal=True)
+    qkv_spec = P(batch_axes, seq_axis, head_axis, None)
+    mask_spec = P(batch_axes, seq_axis)
+    fn = functools.partial(zigzag_ring_attention, axis_name=seq_axis)
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec)
+    return mapped(q, k, v, kv_mask)
+
+
 def _local_attention(q, k, v, kv_mask, *, causal: bool = False):
     """The ring's single-block case without a mesh: one _block_update pass
     (still exact, still O(S) memory in scores per block — here S is global)."""

@@ -6,12 +6,16 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_reference(q, k, v, kv_mask=None):
-    """softmax(QK^T/sqrt(d))V with optional key-padding mask; (B,S,H,D) io."""
+def dense_reference(q, k, v, kv_mask=None, causal=False):
+    """softmax(QK^T/sqrt(d))V with optional key-padding mask and causal
+    triangle; (B,S,H,D) io. The ONE oracle for ring/flash/zigzag suites."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :], s, -1e30)
+    if causal:
+        n = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 

@@ -91,7 +91,6 @@ def test_residual_variant_gradients():
                                    err_msg=name)
 
 
-@pytest.mark.core
 def test_module_matches_flax_batchnorm():
     """Same input -> same output, same running-stat update as nn.BatchNorm
     followed by relu; identical variable tree."""
@@ -132,7 +131,6 @@ def test_module_matches_flax_batchnorm():
     np.testing.assert_allclose(yf2, yc2, rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.core
 def test_resnet_fused_flag_preserves_numerics_and_tree():
     """resnet18_thin with fused_bn=True: identical variable tree, matching
     logits and end-to-end gradients vs the unfused model."""
@@ -179,7 +177,6 @@ def test_bfloat16_path_runs():
     assert y.dtype == jnp.bfloat16 and y.shape == x.shape
 
 
-@pytest.mark.core
 @pytest.mark.usefixtures("devices8")
 def test_fused_dp_step_matches_unfused():
     """Two DP train steps over the 8-device mesh: fused_bn on/off produce

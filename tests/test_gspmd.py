@@ -118,7 +118,6 @@ def test_emulated_hybrid_mesh_layout(devices8):
     assert (slice_of[:, 0] == slice_of[:, 1]).all()
 
 
-@pytest.mark.core
 def test_emulated_hybrid_mesh_trains(devices8):
     # A dp x tp step over the emulated 2-slice mesh compiles and runs.
     cfg = bert_cfg(ParallelConfig(data=4, model=2, emulate_slices=2))

@@ -136,15 +136,9 @@ def test_causal_ring_matches_causal_dense(seq_shards):
     mask = jnp.asarray(mask)
 
     def dense_causal(q, k, v, mask):
-        d = q.shape[-1]
-        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * (d ** -0.5)
-        tri = jnp.tril(jnp.ones((s, s), bool))
-        keep = tri[None, None] & mask[:, None, None, :]
-        sc = jnp.where(keep, sc, -1e30)
-        p = jax.nn.softmax(sc, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p,
-                          v.astype(jnp.float32)).astype(q.dtype)
+        return dense_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                               v.astype(jnp.float32), mask,
+                               causal=True).astype(q.dtype)
 
     ref = dense_causal(q, k, v, mask)
     mesh = meshlib.make_mesh(ParallelConfig(seq=seq_shards))
@@ -180,3 +174,72 @@ def test_gpt_ring_runs_via_loop(devices8):
     summary = loop.run(cfg, total_steps=2, logger=MetricLogger(enabled=False))
     assert summary["final_step"] == 2
     assert np.isfinite(summary["final_metrics"]["loss"])
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("seq_shards", [2, 4])
+def test_zigzag_matches_causal_dense(seq_shards):
+    """Zigzag-sharded causal ring == causal dense in natural order (permute
+    in, compute over the ring, unpermute out) — forward AND gradients (the
+    training-path invariant, same as the plain causal ring's test)."""
+    q, k, v = random_qkv(jax.random.key(5))
+    b, s = q.shape[:2]
+    mask = np.ones((b, s), bool)
+    mask[0, -6:] = False
+    mask = jnp.asarray(mask)
+    perm, inv = ring.zigzag_indices(s, seq_shards)
+    w = jax.random.normal(jax.random.key(6), q.shape)
+
+    mesh = meshlib.make_mesh(ParallelConfig(seq=seq_shards))
+
+    def loss_zig(q, k, v):
+        out = ring.zigzag_ring_attention_sharded(
+            q[:, perm], k[:, perm], v[:, perm], mask[:, perm])
+        return jnp.sum(out[:, inv] * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, mask, causal=True) * w)
+
+    with meshlib.use_mesh(mesh):
+        out_z = jax.jit(lambda *a: ring.zigzag_ring_attention_sharded(*a))(
+            q[:, perm], k[:, perm], v[:, perm], mask[:, perm])
+        np.testing.assert_allclose(
+            np.asarray(out_z)[:, inv],
+            np.asarray(dense_reference(q, k, v, mask, causal=True)),
+            rtol=1e-5, atol=1e-5)
+        gz = jax.jit(jax.grad(loss_zig, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_, name in zip(gz, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.core
+def test_zigzag_schedule_is_balanced():
+    """The zigzag schedule's point: per-device causal work is equal by
+    construction (2 chunk-pairs per arrival + 1 extra on the local step),
+    while contiguous causal sharding loads the last shard with 4n
+    chunk-pair-equivalents — the lockstep ring's critical path."""
+    for n in (2, 4, 8):
+        totals = []
+        for i in range(n):
+            per_step = [len(ring._zigzag_pairs(i, (i - r) % n, n))
+                        for r in range(n)]
+            assert max(per_step) <= 3 and min(per_step) >= 2
+            totals.append(sum(per_step))
+        assert len(set(totals)) == 1, totals          # perfectly balanced
+        assert totals[0] == 2 * n + 1                 # vs 4n contiguous max
+        # the provably-dead pair never fires
+        for i in range(n):
+            for r in range(n):
+                assert (i, 2 * n - 1 - ((i - r) % n)) not in [
+                    p for p in ring._zigzag_pairs(i, (i - r) % n, n)
+                    if p[0] == i and p[1] >= n]
+
+
+def test_zigzag_indices_roundtrip():
+    perm, inv = ring.zigzag_indices(32, 4)
+    x = np.arange(32)
+    np.testing.assert_array_equal(x[perm][inv], x)
+    # shard 0 of 4 owns chunks 0 and 7 of 8
+    np.testing.assert_array_equal(perm[:8], list(range(0, 4)) + list(range(28, 32)))

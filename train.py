@@ -183,7 +183,7 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(remat=True)
     if args.fused_bn:
         cfg = cfg.replace(fused_bn=True)
-    if args.pp_microbatches:
+    if args.pp_microbatches is not None:
         cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
 
     data_updates = {}

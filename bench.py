@@ -343,7 +343,7 @@ def main(argv=None) -> int:
                         "(round(0.15*seq_len), the canonical BERT recipe), "
                         "0 = dense full-sequence logits")
     p.add_argument("--attention-impl", default=None,
-                   choices=[None, "dense", "flash", "ring"],
+                   choices=[None, "dense", "flash", "ring", "zigzag"],
                    help="attention implementation for token models")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer layers in backward")

@@ -19,6 +19,7 @@ import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
@@ -34,7 +35,8 @@ class GptConfig:
     dropout_rate: float = 0.1
     layer_norm_eps: float = 1e-5
     attention_impl: str = "dense"   # dense | flash (causal Pallas kernel) |
-                                    # ring (causal ring over the `seq` axis)
+                                    # ring (causal ring over the `seq` axis) |
+                                    # zigzag (load-balanced causal ring)
     remat: bool = False
     # GPipe pipeline over the `pipeline` mesh axis (models/pipeline.py);
     # num_layers must divide evenly into stages.
@@ -126,6 +128,31 @@ class GptLM(nn.Module):
         pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
                     else attention_mask.astype(jnp.bool_))
 
+        # Zigzag layout (load-balanced causal ring, parallel/ring_attention):
+        # the whole transformer runs in zigzag order — ids/mask/positions
+        # permuted once here, hidden states unpermuted once before the LM
+        # head — so each layer's causal attention is balanced across the
+        # seq shards without per-layer relayout. The permutation is a
+        # trace-time constant from the ambient mesh's seq size; everything
+        # between (LN, MLP, residuals, dropout) is positionwise and thus
+        # permutation-oblivious.
+        inv = None
+        if cfg.attention_impl == "zigzag":
+            from distributeddeeplearning_tpu.parallel.ring_attention import (
+                zigzag_indices)
+            ambient = jax.sharding.get_abstract_mesh()
+            n_seq = (ambient.shape.get("seq", 1)
+                     if ambient is not None and not ambient.empty else 1)
+            if n_seq > 1:
+                if s % (2 * n_seq):
+                    raise ValueError(
+                        f"attention_impl='zigzag' needs seq_len divisible "
+                        f"by 2*seq_shards (= {2 * n_seq}); got {s}")
+                perm, inv = zigzag_indices(s, n_seq)
+                input_ids = input_ids[:, perm]
+                pad_mask = pad_mask[:, perm]
+        pos_index = jnp.asarray(perm) if inv is not None else jnp.arange(s)
+
         wte = self.param(
             "wte", nn.with_logical_partitioning(nn.initializers.normal(0.02),
                                                 ("vocab", "embed")),
@@ -134,7 +161,7 @@ class GptLM(nn.Module):
             "wpe", nn.with_logical_partitioning(nn.initializers.normal(0.01),
                                                 (None, "embed")),
             (cfg.max_position, cfg.hidden_size), jnp.float32)
-        x = (wte[input_ids] + wpe[None, :s]).astype(self.dtype)
+        x = (wte[input_ids] + wpe[None, pos_index]).astype(self.dtype)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
@@ -162,6 +189,13 @@ class GptLM(nn.Module):
                     x = block(x, pad_mask, deterministic=deterministic)
                 x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
+        if inv is not None:
+            # Back to natural order BEFORE the head: unpermuting the (B,S,H)
+            # hidden states costs vocab/hidden (~65x) less traffic than
+            # unpermuting logits, and callers (loss, eval, generation) see
+            # the standard position-aligned contract.
+            x = x[:, jnp.asarray(inv)]
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln_f")(x)
         logits = jnp.einsum("bsh,vh->bsv", x, wte.astype(self.dtype))

@@ -136,6 +136,16 @@ class LlamaLM(nn.Module):
     def __call__(self, input_ids, attention_mask=None, *,
                  train: bool = True):
         cfg = self.cfg
+        if cfg.attention_impl == "zigzag":
+            # zigzag needs the whole model run in permuted layout with
+            # positions mapped through the permutation (models/gpt.py does
+            # this for learned positions); RoPE's rotation indices are not
+            # wired through yet — reject rather than silently attend over
+            # a mislabeled layout.
+            raise ValueError(
+                "attention_impl='zigzag' is not wired for the Llama family "
+                "yet (RoPE positions must follow the zigzag permutation); "
+                "use 'ring' or 'flash'")
         deterministic = not train
         b, s = input_ids.shape
         pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None

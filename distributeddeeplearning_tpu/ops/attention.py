@@ -61,6 +61,18 @@ def multihead_attention(q, k, v, pad_mask, *, impl: str, causal: bool,
         from distributeddeeplearning_tpu.parallel import ring_attention
         out = ring_attention.ring_attention_sharded(
             q, k, v, pad_mask, causal=causal)
+    elif impl == "zigzag":
+        # Load-balanced causal ring: caller (models/gpt.py) has already put
+        # the sequence in zigzag layout, so q/k/v/mask arrive permuted and
+        # the output stays permuted.
+        if not causal:
+            raise ValueError(
+                "attention_impl='zigzag' is causal-only (the zigzag layout "
+                "balances the causal triangle; bidirectional work is "
+                "already uniform — use 'ring')")
+        from distributeddeeplearning_tpu.parallel import ring_attention
+        out = ring_attention.zigzag_ring_attention_sharded(
+            q, k, v, pad_mask)
     elif impl == "dense":
         scale = d ** -0.5
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -135,24 +135,40 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
                            seq_axis: str = "seq",
                            batch_axes=("data", "fsdp"),
                            head_axis: str = "model",
-                           causal: bool = False):
+                           causal: bool = False,
+                           zigzag: bool = False):
     """GSPMD-embeddable wrapper: shard_map over (batch, seq, heads).
 
     Takes *global* (B, S, H, D) arrays inside a jit-traced program (ambient
     mesh from ``use_mesh``), pins the ring layout — batch over the DP axes,
     sequence over ``seq``, heads over ``model`` — and runs ``ring_attention``
     per shard. Heads stay independent, so head sharding composes freely with
-    the sequence ring.
+    the sequence ring. ``zigzag=True`` (implies causal) maps
+    :func:`zigzag_ring_attention` instead — inputs/outputs must already be
+    in zigzag layout (:func:`zigzag_indices`).
     """
     if mesh is None:
         ambient = jax.sharding.get_abstract_mesh()
         if ambient is None or ambient.empty:
             # No mesh context (single-device apply / notebook use): one local
-            # block is the whole ring.
-            return _local_attention(q, k, v, kv_mask, causal=causal)
+            # block is the whole ring. Zigzag over one shard with identity
+            # permutation is plain causal attention.
+            return _local_attention(q, k, v, kv_mask,
+                                    causal=causal or zigzag)
+        mesh_shape = ambient.shape
+    else:
+        mesh_shape = mesh.shape
+    if zigzag and mesh_shape.get(seq_axis, 1) <= 1:
+        # One seq shard: the zigzag permutation is the identity and its
+        # chunk split would demand an even length for nothing — the plain
+        # causal ring (a single local block) is the same computation.
+        zigzag, causal = False, True
     qkv_spec = P(batch_axes, seq_axis, head_axis, None)
     mask_spec = P(batch_axes, seq_axis)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    fn = (functools.partial(zigzag_ring_attention, axis_name=seq_axis)
+          if zigzag else
+          functools.partial(ring_attention, axis_name=seq_axis,
+                            causal=causal))
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
@@ -288,26 +304,12 @@ def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
     return jnp.concatenate([finish(lo), finish(hi)], axis=1).astype(q.dtype)
 
 
-def zigzag_ring_attention_sharded(q, k, v, kv_mask, *,
-                                  mesh: Optional[jax.sharding.Mesh] = None,
-                                  seq_axis: str = "seq",
-                                  batch_axes=("data", "fsdp"),
-                                  head_axis: str = "model"):
+def zigzag_ring_attention_sharded(q, k, v, kv_mask, **kw):
     """GSPMD-embeddable wrapper for :func:`zigzag_ring_attention` — same
     contract as :func:`ring_attention_sharded`, inputs/outputs in zigzag
     layout."""
-    if mesh is None:
-        ambient = jax.sharding.get_abstract_mesh()
-        if ambient is None or ambient.empty:
-            return _local_attention(q, k, v, kv_mask, causal=True)
-    qkv_spec = P(batch_axes, seq_axis, head_axis, None)
-    mask_spec = P(batch_axes, seq_axis)
-    fn = functools.partial(zigzag_ring_attention, axis_name=seq_axis)
-    mapped = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec)
-    return mapped(q, k, v, kv_mask)
+    return ring_attention_sharded(q, k, v, kv_mask, causal=True,
+                                  zigzag=True, **kw)
 
 
 def _local_attention(q, k, v, kv_mask, *, causal: bool = False):

@@ -56,7 +56,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--pp", type=int, default=None,
                    help="pipeline-parallel size (pipelined models)")
     p.add_argument("--attn", default=None,
-                   choices=["dense", "ring", "flash"],
+                   choices=["dense", "ring", "flash", "zigzag"],
                    help="attention impl for transformer models")
     p.add_argument("--remat", action="store_true", default=None,
                    help="rematerialize transformer layers in backward "

@@ -106,6 +106,11 @@ def _registry() -> dict[str, ModelSpec]:
             name="bert_tiny_moe", objective="mlm",
             build=lambda **kw: bert.tiny_bert_mlm(num_experts=4, **kw),
             input_kind="tokens", param_count=0),
+        "bert_tiny_moe2": ModelSpec(
+            name="bert_tiny_moe2", objective="mlm",
+            build=lambda **kw: bert.tiny_bert_mlm(num_experts=4,
+                                                  moe_top_k=2, **kw),
+            input_kind="tokens", param_count=0),
         # BERT-base as a 4-stage GPipe pipeline over the `pipeline` axis.
         "bert_base_pp": ModelSpec(
             name="bert_base_pp", objective="mlm",

@@ -45,6 +45,7 @@ class BertConfig:
     num_experts: int = 0
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1              # 1 = Switch, 2 = GShard routing
     moe_aux_weight: float = 0.01
     # Pipeline parallelism: pipeline_stages > 1 runs the encoder stack as a
     # GPipe schedule over the ``pipeline`` mesh axis (models/pipeline.py);
@@ -115,6 +116,7 @@ class EncoderLayer(nn.Module):
                        intermediate_size=cfg.intermediate_size,
                        num_experts=cfg.num_experts,
                        capacity_factor=cfg.moe_capacity_factor,
+                       router_top_k=cfg.moe_top_k,
                        dtype=self.dtype, name="moe_mlp")(
                            x, deterministic=deterministic)
         else:

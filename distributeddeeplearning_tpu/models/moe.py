@@ -10,10 +10,12 @@ experts over ``expert``, XLA lowers the dispatch/combine einsums to
 all-to-alls over ICI — the compiler-emitted equivalent of hand-written MoE
 dispatch kernels.
 
-Top-1 (Switch) routing with per-row capacity; dropped tokens (over capacity)
-pass through the residual unchanged. The load-balance auxiliary loss is
-``sow``-n into the ``moe_losses`` collection; train/steps.py adds it to the
-objective.
+Top-1 (Switch) or top-2 (GShard) routing with per-row capacity; dropped
+tokens (over capacity) pass through the residual unchanged. With top-2,
+second-choice assignments queue for capacity AFTER all first choices (the
+GShard priority rule) and the two gates are renormalized over the chosen
+pair. The load-balance auxiliary loss is ``sow``-n into the ``moe_losses``
+collection; train/steps.py adds it to the objective.
 """
 
 from __future__ import annotations
@@ -30,14 +32,18 @@ Dtype = Any
 class MoeMlp(nn.Module):
     """Drop-in replacement for the transformer FFN block.
 
-    x: (B, S, H) -> (B, S, H); top-1 routing over ``num_experts`` experts,
-    each a gelu MLP of width ``intermediate_size``.
+    x: (B, S, H) -> (B, S, H); top-1 (Switch) or top-2 (GShard) routing
+    over ``num_experts`` experts (``router_top_k``), each a gelu MLP of
+    width ``intermediate_size``. Per-row expert capacity scales with k
+    (the GShard convention) so second choices aren't starved by a
+    first-choice-sized buffer.
     """
 
     hidden_size: int
     intermediate_size: int
     num_experts: int
     capacity_factor: float = 1.25
+    router_top_k: int = 1           # 1 = Switch, 2 = GShard
     router_jitter: float = 0.01
     dtype: Dtype = jnp.bfloat16
 
@@ -47,7 +53,9 @@ class MoeMlp(nn.Module):
         e = self.num_experts
         # Per-row capacity: how many tokens each expert accepts from one
         # sequence. Static (compile-time) — no dynamic shapes on the MXU.
-        cap = max(int(s / e * self.capacity_factor), 1)
+        # Scales with router_top_k (GShard): top-2 produces 2S assignments
+        # per row, and a k=1-sized buffer would drop most second choices.
+        cap = max(int(s / e * self.capacity_factor * self.router_top_k), 1)
 
         # Router (tiny, replicated). f32 for a stable softmax.
         router_logits = nn.Dense(
@@ -63,11 +71,16 @@ class MoeMlp(nn.Module):
             router_logits = router_logits * noise
         probs = jax.nn.softmax(router_logits, axis=-1)        # (B, S, E)
 
+        if self.router_top_k not in (1, 2):
+            raise ValueError(
+                f"router_top_k={self.router_top_k}; only 1 (Switch) and "
+                f"2 (GShard) are implemented")
         expert_idx = jnp.argmax(probs, axis=-1)               # (B, S)
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
-        gate = jnp.sum(probs * onehot, axis=-1)               # (B, S)
+        gate1_raw = jnp.sum(probs * onehot, axis=-1)          # (B, S)
 
-        # Load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+        # Load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e, with
+        # f_e from FIRST choices (the GShard convention for top-2 too).
         frac_tokens = onehot.mean(axis=(0, 1))                # (E,)
         frac_probs = probs.mean(axis=(0, 1))                  # (E,)
         aux = e * jnp.sum(frac_tokens * frac_probs)
@@ -75,17 +88,45 @@ class MoeMlp(nn.Module):
 
         # Position of each token within its expert's capacity (per row);
         # tokens beyond capacity are dropped (residual passes them through).
-        pos = jnp.cumsum(onehot, axis=1) * onehot             # (B, S, E)
-        keep = (pos > 0) & (pos <= cap)
-        # (B, S, E, C) dispatch/combine in compute dtype, not f32: these are
-        # the largest tensors in the layer (B·S·E·C) and hold only 0/1 and
-        # gate values — bf16 halves their HBM footprint and keeps the
-        # dispatch einsums (the all-to-alls) on the fast MXU path
-        # (VERDICT r2 Weak #8).
-        dispatch = jnp.einsum(                                # (B, S, E, C)
-            "bse,bsec->bsec", (onehot * keep).astype(self.dtype),
-            jax.nn.one_hot(pos - 1.0, cap, dtype=self.dtype))
-        combine = dispatch * gate[..., None, None].astype(self.dtype)
+        pos1 = jnp.cumsum(onehot, axis=1) * onehot            # (B, S, E)
+        keep1 = (pos1 > 0) & (pos1 <= cap)
+
+        def make_dispatch(onehot_k, pos_k, keep_k):
+            # (B, S, E, C) dispatch in compute dtype, not f32: these are
+            # the largest tensors in the layer (B·S·E·C) and hold only 0/1
+            # and gate values — bf16 halves their HBM footprint and keeps
+            # the dispatch einsums (the all-to-alls) on the fast MXU path
+            # (VERDICT r2 Weak #8).
+            return jnp.einsum(
+                "bse,bsec->bsec", (onehot_k * keep_k).astype(self.dtype),
+                jax.nn.one_hot(pos_k - 1.0, cap, dtype=self.dtype))
+
+        if self.router_top_k == 1:
+            dispatch = make_dispatch(onehot, pos1, keep1)
+            combine = dispatch * gate1_raw[..., None, None].astype(self.dtype)
+        else:
+            # Second choice: argmax with the first choice masked out.
+            probs2 = probs * (1.0 - onehot)
+            expert_idx2 = jnp.argmax(probs2, axis=-1)
+            onehot2 = jax.nn.one_hot(expert_idx2, e, dtype=jnp.float32)
+            gate2_raw = jnp.sum(probs * onehot2, axis=-1)
+            # GShard priority: every first-choice assignment takes capacity
+            # before any second choice — pos2 continues each expert's count
+            # from the row's total first-choice load.
+            total1 = jnp.sum(onehot * keep1, axis=1, keepdims=True)  # (B,1,E)
+            pos2 = (jnp.cumsum(onehot2, axis=1) + total1) * onehot2
+            keep2 = (pos2 > 0) & (pos2 <= cap)
+            # Renormalize the surviving gates over the chosen pair, so the
+            # combine weights sum to <= 1 per token.
+            denom = jnp.maximum(gate1_raw + gate2_raw, 1e-9)
+            dispatch1 = make_dispatch(onehot, pos1, keep1)
+            dispatch2 = make_dispatch(onehot2, pos2, keep2)
+            dispatch = dispatch1 + dispatch2  # disjoint capacity slots
+            combine = (
+                dispatch1 * (gate1_raw / denom)[..., None, None]
+                .astype(self.dtype)
+                + dispatch2 * (gate2_raw / denom)[..., None, None]
+                .astype(self.dtype))
 
         # Expert kernels: leading logical axis "experts" -> mesh "expert".
         wi = self.param(

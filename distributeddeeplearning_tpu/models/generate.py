@@ -1,11 +1,16 @@
 """Autoregressive generation for the causal LM families (GPT, Llama).
 
-Deliberately the simple-and-correct formulation: one fixed-shape padded
-forward per emitted token inside a single jitted ``lax.scan`` — no KV-cache
-plumbing in the models, so it works unchanged for every causal variant
-(dense/flash attention, remat, pipelined). O(S^2) per token is irrelevant
-at eval-demo scale; a cached decode path is a later optimization, not a
-correctness requirement.
+Two paths:
+
+- default (``use_cache=False``): one fixed-shape padded forward per
+  emitted token inside a single jitted ``lax.scan`` — no cache plumbing,
+  so it works unchanged for every causal variant (dense/flash attention,
+  remat, pipelined, Llama). O(S^2) per token.
+- ``use_cache=True`` (GPT family): KV-cache incremental decoding — the
+  model's ``decode=True`` mode appends each token's K/V to per-layer
+  (B, max_position, H, D) caches and attends over the live prefix only,
+  O(S) per token. Greedy outputs are identical to the full-refeed path
+  (tests/test_generate.py asserts it).
 
 Sampling: greedy (temperature=0) or temperature softmax with optional
 top-k truncation. Fully deterministic given (params, prompt, seed).
@@ -19,25 +24,7 @@ import jax
 import jax.numpy as jnp
 
 
-def generate(model, variables, prompt_ids, *, max_new_tokens: int,
-             temperature: float = 0.0, top_k: int = 0,
-             rng: Optional[jax.Array] = None, pad_id: int = 0):
-    """Extend ``prompt_ids`` (B, P) by ``max_new_tokens`` tokens.
-
-    Returns (B, P + max_new_tokens) int32. The sequence buffer is padded to
-    the final length up front; the attention mask marks the live prefix, so
-    every scan step runs the same fixed-shape forward (one compile).
-    """
-    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-    b, p = prompt_ids.shape
-    total = p + max_new_tokens
-    if rng is None:
-        rng = jax.random.key(0)
-
-    ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
-    mask0 = (jnp.arange(total)[None, :] < p).astype(jnp.int32)
-    mask0 = jnp.broadcast_to(mask0, (b, total))
-
+def _make_sampler(temperature: float, top_k: int):
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -47,6 +34,50 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
             kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits).astype(jnp.int32)
+    return sample
+
+
+def generate(model, variables, prompt_ids, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Optional[jax.Array] = None, pad_id: int = 0,
+             use_cache: bool = False):
+    """Extend ``prompt_ids`` (B, P) by ``max_new_tokens`` tokens.
+
+    Returns (B, P + max_new_tokens) int32. The sequence buffer is padded to
+    the final length up front; the attention mask marks the live prefix, so
+    every scan step runs the same fixed-shape forward (one compile).
+    ``use_cache=True`` switches to KV-cache incremental decoding (models
+    with a ``decode`` mode — the GPT family).
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    b, p = prompt_ids.shape
+    total = p + max_new_tokens
+    if rng is None:
+        rng = jax.random.key(0)
+    sample = _make_sampler(temperature, top_k)
+
+    if use_cache:
+        import inspect
+
+        if "decode" not in inspect.signature(model.__call__).parameters:
+            raise ValueError(
+                f"use_cache=True needs a model with a decode (KV-cache) "
+                f"mode — the GPT family; {type(model).__name__} has none. "
+                f"Use the default full-refeed path.")
+        max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
+        if max_pos is not None and total > max_pos:
+            # The per-call s=1 forward bypasses the full-sequence length
+            # check; without this guard the cache writes clamp at the last
+            # slot and the output silently degenerates.
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({total - p}) = {total} "
+                f"exceeds the model's max_position {max_pos}")
+        return _generate_cached(model, variables, prompt_ids, total=total,
+                                pad_id=pad_id, sample=sample, rng=rng)
+
+    ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
+    mask0 = (jnp.arange(total)[None, :] < p).astype(jnp.int32)
+    mask0 = jnp.broadcast_to(mask0, (b, total))
 
     def step(carry, _):
         ids, mask, pos, key = carry
@@ -62,4 +93,49 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
 
     (ids, _, _, _), _ = jax.lax.scan(
         step, (ids0, mask0, jnp.int32(p), rng), None, length=max_new_tokens)
+    return ids
+
+
+def _generate_cached(model, variables, prompt_ids, *, total: int,
+                     pad_id: int, sample, rng):
+    """KV-cache decode: feed tokens one at a time (prompt teacher-forced,
+    then sampled), O(S) per token. The first call creates the cache
+    collection; the scan then carries it as a fixed-shape pytree."""
+    b, p = prompt_ids.shape
+    ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
+
+    # Token 0 creates + fills the cache's first slot and yields the logits
+    # for position 1.
+    logits0, mut = model.apply(variables, ids0[:, :1], train=False,
+                               decode=True, mutable=["cache"])
+
+    def step(carry, t):
+        ids, cache, logits, key = carry
+
+        # Split the key and sample ONLY on emission steps: the RNG then
+        # advances exactly once per emitted token — the same consumption
+        # sequence as the full-refeed path, so temperature>0 sampling is
+        # path-identical at the same seed (and prompt steps skip the
+        # sampling compute entirely).
+        def emit(k):
+            k2, sub = jax.random.split(k)
+            return k2, sample(logits, sub)
+
+        def hold(k):
+            return k, jnp.zeros((b,), jnp.int32)
+
+        key, sampled = jax.lax.cond(t >= p, emit, hold, key)
+        cur = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
+        # Inside the prompt: teacher-force the real token; past it: emit.
+        tok = jnp.where(t < p, cur, sampled)
+        ids = jax.lax.dynamic_update_slice(ids, tok[:, None], (0, t))
+        logits, mut = model.apply(
+            {**{k: v for k, v in variables.items() if k != "cache"},
+             "cache": cache},
+            tok[:, None], train=False, decode=True, mutable=["cache"])
+        return (ids, mut["cache"], logits[:, -1], key), None
+
+    (ids, _, _, _), _ = jax.lax.scan(
+        step, (ids0, mut["cache"], logits0[:, -1], rng),
+        jnp.arange(1, total))
     return ids

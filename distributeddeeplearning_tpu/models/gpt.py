@@ -61,7 +61,8 @@ class CausalSelfAttention(nn.Module):
     dtype: Dtype
 
     @nn.compact
-    def __call__(self, x, pad_mask, *, deterministic: bool):
+    def __call__(self, x, pad_mask, *, deterministic: bool,
+                 decode: bool = False):
         cfg = self.cfg
         b, s, _ = x.shape
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -72,14 +73,45 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
-        from distributeddeeplearning_tpu.ops.attention import (
-            multihead_attention)
-        out = multihead_attention(
-            q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
-            dtype=self.dtype,
-            prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
-                p, deterministic=deterministic),
-            warn_dropout_rate=cfg.dropout_rate, deterministic=deterministic)
+        if decode:
+            # Incremental decoding: one token in, K/V appended to a
+            # (B, max_position, H, D) cache, attention over the live prefix
+            # only — O(S) per emitted token vs the full-refeed O(S^2)
+            # (models/generate.py use_cache=True). Each attention module
+            # keeps its own write index, the standard flax cache layout.
+            assert s == 1, f"decode mode takes one token at a time, got {s}"
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, cfg.max_position, cfg.num_heads, head_dim), self.dtype)
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, cfg.max_position, cfg.num_heads, head_dim), self.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+            ci.value = idx + 1
+            live = (jnp.arange(cfg.max_position) <= idx)[None, None, None, :]
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) \
+                * (head_dim ** -0.5)
+            scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+            out = out.reshape(b, s, cfg.hidden_size)
+        else:
+            from distributeddeeplearning_tpu.ops.attention import (
+                multihead_attention)
+            out = multihead_attention(
+                q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
+                dtype=self.dtype,
+                prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
+                    p, deterministic=deterministic),
+                warn_dropout_rate=cfg.dropout_rate,
+                deterministic=deterministic)
         return _dense(cfg.hidden_size, ("heads", "embed"), "output",
                       self.dtype)(out)
 
@@ -91,12 +123,13 @@ class DecoderBlock(nn.Module):
     dtype: Dtype
 
     @nn.compact
-    def __call__(self, x, pad_mask, *, deterministic: bool):
+    def __call__(self, x, pad_mask, *, deterministic: bool,
+                 decode: bool = False):
         cfg = self.cfg
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln1")(x)
         h = CausalSelfAttention(cfg, self.dtype, name="attention")(
-            h, pad_mask, deterministic=deterministic)
+            h, pad_mask, deterministic=deterministic, decode=decode)
         x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln2")(x)
@@ -117,10 +150,14 @@ class GptLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *,
-                 train: bool = True):
+                 train: bool = True, decode: bool = False):
         cfg = self.cfg
         deterministic = not train
         b, s = input_ids.shape
+        if decode and cfg.pipeline_stages > 1:
+            raise ValueError("decode (KV-cache) mode is not supported for "
+                             "pipelined models; generate with the "
+                             "non-pipelined variant")
         if s > cfg.max_position:
             raise ValueError(
                 f"sequence length {s} exceeds max_position "
@@ -137,7 +174,7 @@ class GptLM(nn.Module):
         # between (LN, MLP, residuals, dropout) is positionwise and thus
         # permutation-oblivious.
         inv = None
-        if cfg.attention_impl == "zigzag":
+        if cfg.attention_impl == "zigzag" and not decode:
             from distributeddeeplearning_tpu.parallel.ring_attention import (
                 zigzag_indices)
             ambient = jax.sharding.get_abstract_mesh()
@@ -151,7 +188,17 @@ class GptLM(nn.Module):
                 perm, inv = zigzag_indices(s, n_seq)
                 input_ids = input_ids[:, perm]
                 pad_mask = pad_mask[:, perm]
-        pos_index = jnp.asarray(perm) if inv is not None else jnp.arange(s)
+        if decode:
+            # One token per call: its position is the decode step counter
+            # (a top-level cache variable, advanced once per call; the
+            # per-attention cache indices advance in lockstep).
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos_index = pos_var.value[None]
+            pos_var.value = pos_var.value + 1
+        else:
+            pos_index = (jnp.asarray(perm) if inv is not None
+                         else jnp.arange(s))
 
         wte = self.param(
             "wte", nn.with_logical_partitioning(nn.initializers.normal(0.02),
@@ -180,13 +227,14 @@ class GptLM(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 block = DecoderBlock(cfg, self.dtype, name=f"layer{i}")
-                if cfg.remat:
+                if cfg.remat and not decode:
                     x = nn.remat(
                         lambda mdl, h, m: mdl(
                             h, m, deterministic=deterministic))(
                         block, x, pad_mask)
                 else:
-                    x = block(x, pad_mask, deterministic=deterministic)
+                    x = block(x, pad_mask, deterministic=deterministic,
+                              decode=decode)
                 x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if inv is not None:

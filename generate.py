@@ -33,6 +33,10 @@ def main(argv=None) -> int:
                    help="model context length (defaults to prompt+new)")
     p.add_argument("--vocab-size", type=int, default=None)
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--use-cache", action="store_true",
+                   help="KV-cache incremental decoding (GPT family): O(S) "
+                        "per token instead of full-refeed O(S^2); greedy "
+                        "output is identical")
     args = p.parse_args(argv)
 
     import os
@@ -79,7 +83,7 @@ def main(argv=None) -> int:
     out = generate(model, {"params": params}, prompts,
                    max_new_tokens=args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
-                   rng=jax.random.key(args.seed))
+                   rng=jax.random.key(args.seed), use_cache=args.use_cache)
     for row in jax.device_get(out).tolist():
         print(json.dumps({"tokens": row}), flush=True)
     return 0

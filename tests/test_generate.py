@@ -85,3 +85,49 @@ def test_generate_cli_roundtrip(tmp_path):
     assert len(rows) == 2
     assert rows[0]["tokens"][:3] == [5, 6, 7]
     assert len(rows[0]["tokens"]) == 7
+
+
+def test_cached_decode_matches_full_refeed():
+    """KV-cache incremental decoding (decode=True, O(S)/token) produces the
+    IDENTICAL greedy continuation as the full-refeed path."""
+    from distributeddeeplearning_tpu.models import generate as genlib
+    from distributeddeeplearning_tpu.models import gpt
+
+    model = gpt.tiny_gpt(vocab_size=128, dtype=jnp.float32, seq_len=32)
+    prompt = jnp.asarray([[5, 17, 9], [2, 4, 6]], jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        jnp.zeros((2, 8), jnp.int32), train=False)
+
+    full = genlib.generate(model, variables, prompt, max_new_tokens=6)
+    cached = genlib.generate(model, variables, prompt, max_new_tokens=6,
+                             use_cache=True)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_cached_decode_sampled_parity_and_guards():
+    """temperature>0 sampling is path-identical at the same seed (the RNG
+    advances once per emitted token on both paths); over-length and
+    non-decode models are rejected loudly."""
+    from distributeddeeplearning_tpu.models import generate as genlib
+    from distributeddeeplearning_tpu.models import gpt, llama
+
+    model = gpt.tiny_gpt(vocab_size=128, dtype=jnp.float32, seq_len=32)
+    prompt = jnp.asarray([[5, 17, 9]], jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        jnp.zeros((1, 8), jnp.int32), train=False)
+    kw = dict(max_new_tokens=5, temperature=0.8, top_k=20,
+              rng=jax.random.key(7))
+    full = genlib.generate(model, variables, prompt, **kw)
+    cached = genlib.generate(model, variables, prompt, use_cache=True, **kw)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+    with pytest.raises(ValueError, match="max_position"):
+        genlib.generate(model, variables, prompt, max_new_tokens=1000,
+                        use_cache=True)
+    lm = llama.tiny_llama(vocab_size=128, dtype=jnp.float32)
+    lv = lm.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
+                 jnp.zeros((1, 8), jnp.int32), train=False)
+    with pytest.raises(ValueError, match="decode"):
+        genlib.generate(lm, lv, prompt, max_new_tokens=2, use_cache=True)

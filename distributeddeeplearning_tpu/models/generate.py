@@ -6,11 +6,14 @@ Two paths:
   emitted token inside a single jitted ``lax.scan`` — no cache plumbing,
   so it works unchanged for every causal variant (dense/flash attention,
   remat, pipelined, Llama). O(S^2) per token.
-- ``use_cache=True`` (GPT family): KV-cache incremental decoding — the
-  model's ``decode=True`` mode appends each token's K/V to per-layer
-  (B, max_position, H, D) caches and attends over the live prefix only,
-  O(S) per token. Greedy outputs are identical to the full-refeed path
-  (tests/test_generate.py asserts it).
+- ``use_cache=True`` (GPT and Llama families): KV-cache incremental
+  decoding — the model's ``decode=True`` mode appends each token's K/V to
+  per-layer caches (GPT: (B, max_position, H, D); Llama: kv-head width,
+  the GQA saving, sized by ``cfg.decode_cache_len`` — size it to
+  prompt+new tokens, as the CLI does) and attends over the live prefix,
+  O(S) per token. Outputs are identical to the full-refeed path at the
+  same seed, greedy and sampled (tests/test_generate.py asserts both).
+  Prompt tokens are consumed one per step (no batched prefill yet).
 
 Sampling: greedy (temperature=0) or temperature softmax with optional
 top-k truncation. Fully deterministic given (params, prompt, seed).
@@ -47,7 +50,7 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
     the final length up front; the attention mask marks the live prefix, so
     every scan step runs the same fixed-shape forward (one compile).
     ``use_cache=True`` switches to KV-cache incremental decoding (models
-    with a ``decode`` mode — the GPT family).
+    with a ``decode`` mode — the GPT and Llama families).
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, p = prompt_ids.shape
@@ -62,9 +65,11 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
         if "decode" not in inspect.signature(model.__call__).parameters:
             raise ValueError(
                 f"use_cache=True needs a model with a decode (KV-cache) "
-                f"mode — the GPT family; {type(model).__name__} has none. "
-                f"Use the default full-refeed path.")
-        max_pos = getattr(getattr(model, "cfg", None), "max_position", None)
+                f"mode — the GPT/Llama families; {type(model).__name__} "
+                f"has none. Use the default full-refeed path.")
+        mcfg = getattr(model, "cfg", None)
+        max_pos = (getattr(mcfg, "max_position", None)
+                   or getattr(mcfg, "decode_cache_len", None))
         if max_pos is not None and total > max_pos:
             # The per-call s=1 forward bypasses the full-sequence length
             # check; without this guard the cache writes clamp at the last
@@ -103,10 +108,14 @@ def _generate_cached(model, variables, prompt_ids, *, total: int,
     collection; the scan then carries it as a fixed-shape pytree."""
     b, p = prompt_ids.shape
     ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
+    if total == p:  # max_new_tokens == 0: nothing to emit
+        return ids0
 
     # Token 0 creates + fills the cache's first slot and yields the logits
-    # for position 1.
-    logits0, mut = model.apply(variables, ids0[:, :1], train=False,
+    # for position 1. Any caller-supplied 'cache' collection is dropped —
+    # decoding must start from index 0, not a stale cache.
+    fresh = {k: v for k, v in variables.items() if k != "cache"}
+    logits0, mut = model.apply(fresh, ids0[:, :1], train=False,
                                decode=True, mutable=["cache"])
 
     def step(carry, t):
@@ -130,12 +139,17 @@ def _generate_cached(model, variables, prompt_ids, *, total: int,
         tok = jnp.where(t < p, cur, sampled)
         ids = jax.lax.dynamic_update_slice(ids, tok[:, None], (0, t))
         logits, mut = model.apply(
-            {**{k: v for k, v in variables.items() if k != "cache"},
-             "cache": cache},
+            {**fresh, "cache": cache},
             tok[:, None], train=False, decode=True, mutable=["cache"])
         return (ids, mut["cache"], logits[:, -1], key), None
 
-    (ids, _, _, _), _ = jax.lax.scan(
+    # Scan feeds tokens 1..total-2; the LAST token is sampled from the
+    # carried logits outside the scan — feeding it would run one forward
+    # whose logits nobody consumes.
+    (ids, _, logits, key), _ = jax.lax.scan(
         step, (ids0, mut["cache"], logits0[:, -1], rng),
-        jnp.arange(1, total))
+        jnp.arange(1, total - 1))
+    _, last = jax.random.split(key)
+    ids = jax.lax.dynamic_update_slice(
+        ids, sample(logits, last)[:, None], (0, total - 1))
     return ids

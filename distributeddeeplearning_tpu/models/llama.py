@@ -16,6 +16,7 @@ import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
@@ -34,6 +35,9 @@ class LlamaConfig:
     dropout_rate: float = 0.0       # llama pretraining uses no dropout
     attention_impl: str = "dense"   # dense | flash | ring (causal)
     remat: bool = False
+    # KV-cache buffer length for decode mode (RoPE has no position table,
+    # so this is the only static sequence bound generation needs).
+    decode_cache_len: int = 2048
 
     @property
     def head_dim(self) -> int:
@@ -53,13 +57,16 @@ def _rms_norm(cfg: LlamaConfig, dtype, name: str):
                       param_dtype=jnp.float32, name=name)
 
 
-def apply_rope(x, *, theta: float):
+def apply_rope(x, *, theta: float, offset=0):
     """Rotary embedding, half-split (rotate_half) convention: x (B, S, H, D)
-    rotated by position along dim 1. f32 rotation regardless of storage
-    dtype (sin/cos in bf16 visibly degrades long-range phase)."""
+    rotated by (offset + index) along dim 1 — ``offset`` (may be traced)
+    positions a decode-mode single token at its absolute index. f32
+    rotation regardless of storage dtype (sin/cos in bf16 visibly degrades
+    long-range phase)."""
     b, s, h, d = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    pos = offset + jnp.arange(s, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     xf = x.astype(jnp.float32)
@@ -73,7 +80,8 @@ class LlamaAttention(nn.Module):
     dtype: Dtype
 
     @nn.compact
-    def __call__(self, x, pad_mask, *, deterministic: bool):
+    def __call__(self, x, pad_mask, *, deterministic: bool,
+                 decode: bool = False):
         cfg = self.cfg
         b, s, _ = x.shape
         d = cfg.head_dim
@@ -83,6 +91,8 @@ class LlamaAttention(nn.Module):
                    self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
         v = _dense(cfg.num_kv_heads * d, ("embed", "heads"), "v_proj",
                    self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
+        if decode:
+            return self._decode_step(q, k, v)
         q = apply_rope(q, theta=cfg.rope_theta)
         k = apply_rope(k, theta=cfg.rope_theta)
         if cfg.num_kv_heads != cfg.num_heads:
@@ -101,6 +111,42 @@ class LlamaAttention(nn.Module):
         return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
                       self.dtype)(out)
 
+    def _decode_step(self, q, k, v):
+        """KV-cache decode: one token in, K/V cached at kv-head width (the
+        GQA saving generation exists for), grouped-einsum attention over
+        the live prefix. RoPE rotates q/k at the absolute decode index
+        BEFORE caching (absolute-position convention)."""
+        cfg = self.cfg
+        b, s, _, d = q.shape
+        assert s == 1, f"decode mode takes one token at a time, got {s}"
+        kvh = cfg.num_kv_heads
+        rep = cfg.num_heads // kvh
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (b, cfg.decode_cache_len, kvh, d), self.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (b, cfg.decode_cache_len, kvh, d), self.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        q = apply_rope(q, theta=cfg.rope_theta, offset=idx)
+        k = apply_rope(k, theta=cfg.rope_theta, offset=idx)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+        ci.value = idx + 1
+        qg = q.reshape(b, 1, kvh, rep, d)
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck.value) * (d ** -0.5)
+        live = (jnp.arange(cfg.decode_cache_len) <= idx)[
+            None, None, None, None, :]
+        scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(self.dtype)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv.value)
+        out = out.reshape(b, 1, cfg.num_heads * d)
+        return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      self.dtype)(out)
+
 
 class LlamaBlock(nn.Module):
     """Pre-RMSNorm block: x + Attn(norm(x)); x + SwiGLU(norm(x))."""
@@ -109,11 +155,12 @@ class LlamaBlock(nn.Module):
     dtype: Dtype
 
     @nn.compact
-    def __call__(self, x, pad_mask, *, deterministic: bool):
+    def __call__(self, x, pad_mask, *, deterministic: bool,
+                 decode: bool = False):
         cfg = self.cfg
         h = _rms_norm(cfg, self.dtype, "attention_norm")(x)
         h = LlamaAttention(cfg, self.dtype, name="attention")(
-            h, pad_mask, deterministic=deterministic)
+            h, pad_mask, deterministic=deterministic, decode=decode)
         x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = _rms_norm(cfg, self.dtype, "mlp_norm")(x)
         gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
@@ -134,7 +181,7 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *,
-                 train: bool = True):
+                 train: bool = True, decode: bool = False):
         cfg = self.cfg
         if cfg.attention_impl == "zigzag":
             # zigzag needs the whole model run in permuted layout with
@@ -161,13 +208,14 @@ class LlamaLM(nn.Module):
 
         for i in range(cfg.num_layers):
             block = LlamaBlock(cfg, self.dtype, name=f"layer{i}")
-            if cfg.remat:
+            if cfg.remat and not decode:
                 x = nn.remat(
                     lambda mdl, h, m: mdl(
                         h, m, deterministic=deterministic))(
                     block, x, pad_mask)
             else:
-                x = block(x, pad_mask, deterministic=deterministic)
+                x = block(x, pad_mask, deterministic=deterministic,
+                          decode=decode)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         x = _rms_norm(cfg, self.dtype, "final_norm")(x)

@@ -34,9 +34,9 @@ def main(argv=None) -> int:
     p.add_argument("--vocab-size", type=int, default=None)
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--use-cache", action="store_true",
-                   help="KV-cache incremental decoding (GPT family): O(S) "
-                        "per token instead of full-refeed O(S^2); greedy "
-                        "output is identical")
+                   help="KV-cache incremental decoding (GPT and Llama "
+                        "families): O(S) per token instead of full-refeed "
+                        "O(S^2); output is identical at the same seed")
     args = p.parse_args(argv)
 
     import os
@@ -75,6 +75,13 @@ def main(argv=None) -> int:
         params = ckpt.restore_latest_params(state.params)
     finally:
         ckpt.close()
+    if args.use_cache and hasattr(model, "cfg") and hasattr(
+            model.cfg, "decode_cache_len"):
+        # Right-size the Llama KV cache to this request: a fixed default
+        # buffer would make every decode step attend over unused slots.
+        import dataclasses
+        model = model.clone(cfg=dataclasses.replace(
+            model.cfg, decode_cache_len=total))
     if params is None:
         raise SystemExit(
             f"no checkpoint in {args.checkpoint_dir!r}; refusing to sample "

@@ -110,7 +110,7 @@ def test_cached_decode_sampled_parity_and_guards():
     advances once per emitted token on both paths); over-length and
     non-decode models are rejected loudly."""
     from distributeddeeplearning_tpu.models import generate as genlib
-    from distributeddeeplearning_tpu.models import gpt, llama
+    from distributeddeeplearning_tpu.models import gpt
 
     model = gpt.tiny_gpt(vocab_size=128, dtype=jnp.float32, seq_len=32)
     prompt = jnp.asarray([[5, 17, 9]], jnp.int32)
@@ -126,8 +126,27 @@ def test_cached_decode_sampled_parity_and_guards():
     with pytest.raises(ValueError, match="max_position"):
         genlib.generate(model, variables, prompt, max_new_tokens=1000,
                         use_cache=True)
-    lm = llama.tiny_llama(vocab_size=128, dtype=jnp.float32)
-    lv = lm.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
+    # BERT has no decode mode -> loud reject.
+    from distributeddeeplearning_tpu.models import bert
+    bm = bert.tiny_bert_mlm(vocab_size=128, dtype=jnp.float32)
+    bv = bm.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
                  jnp.zeros((1, 8), jnp.int32), train=False)
     with pytest.raises(ValueError, match="decode"):
-        genlib.generate(lm, lv, prompt, max_new_tokens=2, use_cache=True)
+        genlib.generate(bm, bv, prompt, max_new_tokens=2, use_cache=True)
+
+
+def test_llama_cached_decode_matches_full_refeed():
+    """Llama (GQA 4/2, RoPE at absolute decode index, kv-head-width cache):
+    cached greedy continuation == full refeed."""
+    from distributeddeeplearning_tpu.models import generate as genlib
+    from distributeddeeplearning_tpu.models import llama
+
+    model = llama.tiny_llama(vocab_size=128, dtype=jnp.float32)
+    prompt = jnp.asarray([[5, 17, 9], [2, 4, 6]], jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        jnp.zeros((2, 8), jnp.int32), train=False)
+    full = genlib.generate(model, variables, prompt, max_new_tokens=6)
+    cached = genlib.generate(model, variables, prompt, max_new_tokens=6,
+                             use_cache=True)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))

@@ -11,9 +11,9 @@ Two paths:
   per-layer caches (GPT: (B, max_position, H, D); Llama: kv-head width,
   the GQA saving, sized by ``cfg.decode_cache_len`` — size it to
   prompt+new tokens, as the CLI does) and attends over the live prefix,
-  O(S) per token. Outputs are identical to the full-refeed path at the
-  same seed, greedy and sampled (tests/test_generate.py asserts both).
-  Prompt tokens are consumed one per step (no batched prefill yet).
+  O(S) per token. The prompt primes the cache in ONE batched prefill
+  forward. Outputs are identical to the full-refeed path at the same
+  seed, greedy and sampled (tests/test_generate.py asserts both).
 
 Sampling: greedy (temperature=0) or temperature softmax with optional
 top-k truncation. Fully deterministic given (params, prompt, seed).
@@ -71,9 +71,10 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
         max_pos = (getattr(mcfg, "max_position", None)
                    or getattr(mcfg, "decode_cache_len", None))
         if max_pos is not None and total > max_pos:
-            # The per-call s=1 forward bypasses the full-sequence length
-            # check; without this guard the cache writes clamp at the last
-            # slot and the output silently degenerates.
+            # The models check the PREFILL block length themselves, but the
+            # single-token emission steps afterwards would write past the
+            # cache (clamped, silently degenerate) — this guard covers the
+            # full prompt+new budget up front.
             raise ValueError(
                 f"prompt ({p}) + max_new_tokens ({total - p}) = {total} "
                 f"exceeds the model's max_position {max_pos}")
@@ -103,52 +104,40 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
 
 def _generate_cached(model, variables, prompt_ids, *, total: int,
                      pad_id: int, sample, rng):
-    """KV-cache decode: feed tokens one at a time (prompt teacher-forced,
-    then sampled), O(S) per token. The first call creates the cache
-    collection; the scan then carries it as a fixed-shape pytree."""
+    """KV-cache decode: ONE batched prefill forward primes the cache with
+    the whole prompt (its last logits predict position p), then one
+    single-token forward per emitted token. The prefill creates the cache
+    collection; the scan carries it as a fixed-shape pytree."""
     b, p = prompt_ids.shape
     ids0 = jnp.full((b, total), pad_id, jnp.int32).at[:, :p].set(prompt_ids)
     if total == p:  # max_new_tokens == 0: nothing to emit
         return ids0
 
-    # Token 0 creates + fills the cache's first slot and yields the logits
-    # for position 1. Any caller-supplied 'cache' collection is dropped —
-    # decoding must start from index 0, not a stale cache.
+    # Any caller-supplied 'cache' collection is dropped — decoding must
+    # start from index 0, not a stale cache.
     fresh = {k: v for k, v in variables.items() if k != "cache"}
-    logits0, mut = model.apply(fresh, ids0[:, :1], train=False,
+    logits0, mut = model.apply(fresh, prompt_ids, train=False,
                                decode=True, mutable=["cache"])
 
     def step(carry, t):
         ids, cache, logits, key = carry
-
-        # Split the key and sample ONLY on emission steps: the RNG then
-        # advances exactly once per emitted token — the same consumption
-        # sequence as the full-refeed path, so temperature>0 sampling is
-        # path-identical at the same seed (and prompt steps skip the
-        # sampling compute entirely).
-        def emit(k):
-            k2, sub = jax.random.split(k)
-            return k2, sample(logits, sub)
-
-        def hold(k):
-            return k, jnp.zeros((b,), jnp.int32)
-
-        key, sampled = jax.lax.cond(t >= p, emit, hold, key)
-        cur = jax.lax.dynamic_slice_in_dim(ids, t, 1, axis=1)[:, 0]
-        # Inside the prompt: teacher-force the real token; past it: emit.
-        tok = jnp.where(t < p, cur, sampled)
+        # One RNG split per emitted token — the same consumption sequence
+        # as the full-refeed path, so temperature>0 sampling is
+        # path-identical at the same seed.
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
         ids = jax.lax.dynamic_update_slice(ids, tok[:, None], (0, t))
         logits, mut = model.apply(
             {**fresh, "cache": cache},
             tok[:, None], train=False, decode=True, mutable=["cache"])
         return (ids, mut["cache"], logits[:, -1], key), None
 
-    # Scan feeds tokens 1..total-2; the LAST token is sampled from the
+    # Scan emits tokens p..total-2; the LAST token is sampled from the
     # carried logits outside the scan — feeding it would run one forward
     # whose logits nobody consumes.
     (ids, _, logits, key), _ = jax.lax.scan(
         step, (ids0, mut["cache"], logits0[:, -1], rng),
-        jnp.arange(1, total - 1))
+        jnp.arange(p, total - 1))
     _, last = jax.random.split(key)
     ids = jax.lax.dynamic_update_slice(
         ids, sample(logits, last)[:, None], (0, total - 1))

@@ -74,12 +74,12 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
         if decode:
-            # Incremental decoding: one token in, K/V appended to a
-            # (B, max_position, H, D) cache, attention over the live prefix
-            # only — O(S) per emitted token vs the full-refeed O(S^2)
+            # Incremental decoding: a block of s tokens (s = prompt length
+            # on the prefill call, 1 per step after) is appended to a
+            # (B, max_position, H, D) cache and attends over the live
+            # prefix — O(S) per emitted token vs the full-refeed O(S^2)
             # (models/generate.py use_cache=True). Each attention module
             # keeps its own write index, the standard flax cache layout.
-            assert s == 1, f"decode mode takes one token at a time, got {s}"
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (b, cfg.max_position, cfg.num_heads, head_dim), self.dtype)
@@ -93,8 +93,11 @@ class CausalSelfAttention(nn.Module):
                 ck.value, k.astype(self.dtype), (0, idx, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(self.dtype), (0, idx, 0, 0))
-            ci.value = idx + 1
-            live = (jnp.arange(cfg.max_position) <= idx)[None, None, None, :]
+            ci.value = idx + s
+            # Query j (global position idx+j) sees cache slots <= idx+j:
+            # causal within the written block, everything before it.
+            live = (jnp.arange(cfg.max_position)[None, :]
+                    <= (idx + jnp.arange(s))[:, None])[None, None]
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) \
                 * (head_dim ** -0.5)
             scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
@@ -189,13 +192,14 @@ class GptLM(nn.Module):
                 input_ids = input_ids[:, perm]
                 pad_mask = pad_mask[:, perm]
         if decode:
-            # One token per call: its position is the decode step counter
-            # (a top-level cache variable, advanced once per call; the
-            # per-attention cache indices advance in lockstep).
+            # Positions continue from the decode counter (a top-level cache
+            # variable advanced by the block length; per-attention cache
+            # indices advance in lockstep) — s = prompt length on prefill,
+            # 1 per emitted token after.
             pos_var = self.variable("cache", "position",
                                     lambda: jnp.zeros((), jnp.int32))
-            pos_index = pos_var.value[None]
-            pos_var.value = pos_var.value + 1
+            pos_index = pos_var.value + jnp.arange(s)
+            pos_var.value = pos_var.value + s
         else:
             pos_index = (jnp.asarray(perm) if inv is not None
                          else jnp.arange(s))

@@ -112,13 +112,18 @@ class LlamaAttention(nn.Module):
                       self.dtype)(out)
 
     def _decode_step(self, q, k, v):
-        """KV-cache decode: one token in, K/V cached at kv-head width (the
-        GQA saving generation exists for), grouped-einsum attention over
-        the live prefix. RoPE rotates q/k at the absolute decode index
-        BEFORE caching (absolute-position convention)."""
+        """KV-cache decode: a block of s tokens (prompt prefill) or one
+        token (steady state), K/V cached at kv-head width (the GQA saving
+        generation exists for), grouped-einsum attention over the live
+        prefix. RoPE rotates q/k at absolute decode indices BEFORE caching
+        (absolute-position convention)."""
         cfg = self.cfg
         b, s, _, d = q.shape
-        assert s == 1, f"decode mode takes one token at a time, got {s}"
+        if s > cfg.decode_cache_len:
+            raise ValueError(
+                f"decode block of {s} tokens exceeds decode_cache_len="
+                f"{cfg.decode_cache_len}; rebuild with a larger cache "
+                f"(the CLI sizes it to prompt+new automatically)")
         kvh = cfg.num_kv_heads
         rep = cfg.num_heads // kvh
         ck = self.variable("cache", "cached_key", jnp.zeros,
@@ -134,16 +139,17 @@ class LlamaAttention(nn.Module):
             ck.value, k.astype(self.dtype), (0, idx, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(self.dtype), (0, idx, 0, 0))
-        ci.value = idx + 1
-        qg = q.reshape(b, 1, kvh, rep, d)
+        ci.value = idx + s
+        qg = q.reshape(b, s, kvh, rep, d)
         scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck.value) * (d ** -0.5)
-        live = (jnp.arange(cfg.decode_cache_len) <= idx)[
-            None, None, None, None, :]
+        # Query j (global idx+j) sees cache slots <= idx+j.
+        live = (jnp.arange(cfg.decode_cache_len)[None, :]
+                <= (idx + jnp.arange(s))[:, None])[None, None, None]
         scores = jnp.where(live, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32),
                                axis=-1).astype(self.dtype)
         out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv.value)
-        out = out.reshape(b, 1, cfg.num_heads * d)
+        out = out.reshape(b, s, cfg.num_heads * d)
         return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
                       self.dtype)(out)
 

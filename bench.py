@@ -116,6 +116,8 @@ def _protocol_suffix(args) -> str:
         parts.append("remat")
     if getattr(args, "fused_bn", False):
         parts.append("fusedbn")
+    if getattr(args, "fused_block", False):
+        parts.append("fusedblock")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -169,6 +171,7 @@ def _child_measure(args, emit_quick: bool = True) -> None:
         attention_impl=args.attention_impl,
         remat=args.remat,
         fused_bn=args.fused_bn,
+        fused_block=args.fused_block,
         parallel=ParallelConfig(data=n_dev),
         data=data)
 
@@ -242,6 +245,7 @@ def _child(args) -> int:
         row = copy.copy(args)
         row.model = model
         row.attention_impl, row.remat, row.fused_bn = None, False, False
+        row.fused_block = False
         for k, v in overrides.items():
             setattr(row, k, v)
         try:
@@ -349,6 +353,9 @@ def main(argv=None) -> int:
                    help="rematerialize transformer layers in backward")
     p.add_argument("--fused-bn", action="store_true",
                    help="Pallas fused BN(+residual)+ReLU kernels (CNNs)")
+    p.add_argument("--fused-block", action="store_true",
+                   help="conv-epilogue fusion: 1x1 convs as Pallas "
+                        "matmul+BN (resnet50/101/152)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -396,6 +403,8 @@ def main(argv=None) -> int:
         child_cmd += ["--remat"]
     if args.fused_bn:
         child_cmd += ["--fused-bn"]
+    if args.fused_block:
+        child_cmd += ["--fused-block"]
     if args.suite:
         child_cmd += ["--suite"]
         args.attempt_timeout = max(args.attempt_timeout, args.budget)

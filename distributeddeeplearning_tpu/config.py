@@ -134,6 +134,8 @@ class TrainConfig:
     remat: bool = False           # recompute transformer-layer activations
                                   # in backward (less HBM, ~1/3 more FLOPs)
     fused_bn: bool = False        # Pallas fused BN+ReLU kernels (CNNs)
+    fused_block: bool = False     # conv-epilogue fusion: bottleneck 1x1
+                                  # convs as Pallas matmul+BN (resnet50+)
     # GPipe microbatch count for *_pp models (None = model default). The
     # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
     # under ~20% (tools/bench_parallel_overhead.py measures this).

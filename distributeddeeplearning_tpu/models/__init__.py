@@ -38,6 +38,8 @@ def _registry() -> dict[str, ModelSpec]:
     return {
         "resnet18": img(resnet.resnet18, "resnet18", 11_689_512),
         "resnet18_thin": img(resnet.resnet18_thin, "resnet18_thin", 831_096),
+        "resnet26_thin": img(resnet.resnet26_thin, "resnet26_thin",
+                             1_392_184),
         "resnet34": img(resnet.resnet34, "resnet34", 21_797_672),
         "resnet50": img(resnet.resnet50, "resnet50", 25_557_032),
         "resnet101": img(resnet.resnet101, "resnet101", 44_549_160),

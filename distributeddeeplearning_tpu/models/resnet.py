@@ -96,6 +96,11 @@ class ResNet(nn.Module):
     # step in BN-statistics/dγ/dβ/dx reductions). Same variable layout and
     # numerics as the unfused path; off by default until measured on-chip.
     fused_bn: bool = False
+    # Conv-epilogue fusion (ops/fused_linear_bn.py): bottleneck 1x1 convs
+    # run as Pallas matmuls carrying BN statistics in their epilogue and
+    # bn2's apply in conv3's prologue (models/fused_block.py). Bottleneck
+    # nets only; variable-compatible with the unfused path.
+    fused_block: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -132,12 +137,25 @@ class ResNet(nn.Module):
                  padding=[(3, 3), (3, 3)], name="conv_stem")(x)
         x = norm_act(x, name="bn_stem")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        use_fused_block = self.fused_block and self.block is BottleneckBlock
+        if self.fused_block and not use_fused_block:
+            raise ValueError("fused_block requires bottleneck blocks "
+                             "(resnet50/101/152); basic blocks have no 1x1 "
+                             "convolutions to fuse")
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(filters=self.width * 2 ** i, strides=strides,
-                               conv=conv, norm_act=norm_act,
-                               name=f"stage{i + 1}_block{j + 1}")(x)
+                name = f"stage{i + 1}_block{j + 1}"
+                if use_fused_block:
+                    from distributeddeeplearning_tpu.models.fused_block \
+                        import FusedBottleneckBlock
+                    x = FusedBottleneckBlock(
+                        filters=self.width * 2 ** i, strides=strides,
+                        dtype=self.dtype, name=name)(x, train=train)
+                else:
+                    x = self.block(filters=self.width * 2 ** i,
+                                   strides=strides, conv=conv,
+                                   norm_act=norm_act, name=name)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32,
@@ -148,39 +166,48 @@ class ResNet(nn.Module):
 
 
 def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn)
+                  fused_bn=fused_bn, fused_block=fused_block)
 
 
 def resnet18_thin(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-                  fused_bn: bool = False) -> ResNet:
+                  fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     """Width-16 ResNet-18 (1/16th the conv FLOPs): the CPU-tractable stand-in
     for convergence-recipe demonstrations (tools/convergence_lars.py) and
     fast tests — same depth, blocks, and BN structure as the real thing."""
     return ResNet([2, 2, 2, 2], BasicBlock, num_classes, width=16,
-                  dtype=dtype, fused_bn=fused_bn)
+                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block)
+
+
+def resnet26_thin(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+                  fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+    """Width-16 bottleneck ResNet-26 ([2,2,2,2] Bottleneck): the
+    CPU-tractable stand-in with the SAME block structure as resnet50 —
+    what fused_block tests and bottleneck recipe demos run on."""
+    return ResNet([2, 2, 2, 2], BottleneckBlock, num_classes, width=16,
+                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block)
 
 
 def resnet34(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn)
+                  fused_bn=fused_bn, fused_block=fused_block)
 
 
 def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn)
+                  fused_bn=fused_bn, fused_block=fused_block)
 
 
 def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn)
+                  fused_bn=fused_bn, fused_block=fused_block)
 
 
 def resnet152(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
     return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn)
+                  fused_bn=fused_bn, fused_block=fused_block)

@@ -81,6 +81,8 @@ def build(config: TrainConfig, total_steps: int):
         kw["remat"] = True
     if config.fused_bn:
         kw["fused_bn"] = True
+    if config.fused_block:
+        kw["fused_block"] = True
     if config.pipeline_microbatches:
         kw["pipeline_microbatches"] = config.pipeline_microbatches
     model = spec.build(**kw)

@@ -1,0 +1,139 @@
+"""FusedBottleneckBlock vs the classic BottleneckBlock with shared weights.
+
+The fused path (models/fused_block.py over ops/fused_linear_bn.py) must be
+variable-compatible with the unfused ResNet — same param/batch_stats tree —
+and numerically equivalent to bf16 rounding in forward, gradients, and the
+running-statistics update. A small bottleneck ResNet keeps interpret-mode
+kernel runtime tractable on CPU.
+"""
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models.resnet import (
+    BottleneckBlock, ResNet)
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _tiny(fused_block, dtype=jnp.float32):
+    return ResNet([1, 1], BottleneckBlock, num_classes=10, width=16,
+                  dtype=dtype, fused_block=fused_block)
+
+
+@pytest.fixture(scope="module")
+def shared():
+    model = _tiny(False)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    variables = model.init(jax.random.key(1), x, train=True)
+    return x, variables
+
+
+@pytest.mark.core
+def test_variable_trees_identical(shared):
+    x, variables = shared
+    vf = _tiny(True).init(jax.random.key(1), x, train=True)
+    paths = lambda tree: {jax.tree_util.keystr(p)
+                          for p, _ in jax.tree_util.tree_leaves_with_path(tree)}
+    assert paths(vf) == paths(variables)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(vf),
+            jax.tree_util.tree_leaves_with_path(variables)):
+        assert a.shape == b.shape and a.dtype == b.dtype, pa
+
+
+@pytest.mark.core
+def test_forward_and_stats_match(shared):
+    x, variables = shared
+    yu, su = _tiny(False).apply(variables, x, train=True,
+                                mutable=["batch_stats"])
+    yf, sf = _tiny(True).apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+    np.testing.assert_allclose(yf, yu, rtol=2e-4, atol=2e-4)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(sf),
+            jax.tree_util.tree_leaves_with_path(su)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                   err_msg=jax.tree_util.keystr(pa))
+
+
+def test_gradients_match(shared):
+    x, variables = shared
+    labels = jnp.arange(4) % 10
+
+    def loss(params, fused):
+        logits, _ = _tiny(fused).apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+    gu = jax.grad(loss)(variables["params"], False)
+    gf = jax.grad(loss)(variables["params"], True)
+    flat_u = flax.traverse_util.flatten_dict(gu)
+    flat_f = flax.traverse_util.flatten_dict(gf)
+    assert flat_u.keys() == flat_f.keys()
+    for k in flat_u:
+        np.testing.assert_allclose(
+            flat_f[k], flat_u[k], rtol=5e-3, atol=5e-4,
+            err_msg="/".join(k))
+
+
+def test_eval_path_matches(shared):
+    x, variables = shared
+    yu = _tiny(False).apply(variables, x, train=False)
+    yf = _tiny(True).apply(variables, x, train=False)
+    np.testing.assert_allclose(yf, yu, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.usefixtures("devices8")
+def test_fused_block_dp_step_matches_unfused():
+    """Two DP train steps over the 8-device mesh: fused_block on/off give
+    the same loss trajectory (the shard_map/check_vma jnp-twin path)."""
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu import data as datalib
+    from distributeddeeplearning_tpu.train import loop
+
+    losses = {}
+    for fused in (False, True):
+        cfg = TrainConfig(
+            model="resnet26_thin", global_batch_size=32, dtype="float32",
+            log_every=10**9, fused_block=fused,
+            parallel=ParallelConfig(data=8),
+            data=DataConfig(synthetic=True, image_size=32, num_classes=10,
+                            synthetic_learnable=True))
+        mesh, model, batch_shd, state, train_step, _, rng = loop.build(cfg, 2)
+        src = datalib.make_source(cfg, "image", batch_shd)
+        out = []
+        for i in range(2):
+            state, metrics = train_step(state, src.batch(i), rng)
+            out.append(float(metrics["loss"]))
+        losses[fused] = out
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_odd_spatial_size_matches_unfused():
+    """Stride-2 stages on odd spatial dims: conv2 emits ceil(h/2) rows, so
+    the fused path must not assume floor division (regression)."""
+    x = jax.random.normal(jax.random.key(5), (2, 25, 25, 3))
+    variables = _tiny(False).init(jax.random.key(1), x, train=True)
+    yu, _ = _tiny(False).apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+    yf, _ = _tiny(True).apply(variables, x, train=True,
+                              mutable=["batch_stats"])
+    np.testing.assert_allclose(yf, yu, rtol=2e-4, atol=2e-4)
+
+
+def test_basic_block_rejects_fused_block():
+    model = ResNet([1, 1], __import__(
+        "distributeddeeplearning_tpu.models.resnet",
+        fromlist=["BasicBlock"]).BasicBlock, num_classes=10, width=16,
+        fused_block=True)
+    x = jnp.zeros((2, 32, 32, 3))
+    with pytest.raises(ValueError, match="bottleneck"):
+        model.init(jax.random.key(0), x, train=True)

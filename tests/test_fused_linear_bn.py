@@ -1,0 +1,151 @@
+"""Numerics of the fused matmul+BN op vs the unfused jnp composition.
+
+The op under test is the conv-epilogue fusion (ops/fused_linear_bn.py):
+prologue BN-apply + matmul + per-channel Σy/Σy² epilogue, with a custom
+VJP whose backward is two matmul kernels. Off-TPU the same kernels run in
+Pallas interpret mode, so these tests exercise the real kernel bodies.
+
+Reference semantics: stats are taken over y AS STORED (bf16 in training),
+and μ/inv are differentiable inputs — the reference composition below
+mirrors both, so everything (including dμ/dinv cotangents) must agree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.ops import fused_linear_bn as flb
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _ref(x, mu, inv, gamma, beta, w, relu, bn):
+    a = x
+    if bn:
+        af = (x.astype(jnp.float32) - mu) * (inv * gamma) + beta
+        if relu:
+            af = jnp.maximum(af, 0.0)
+        a = af.astype(x.dtype)
+    y = jnp.dot(a.astype(jnp.float32), w.astype(jnp.float32)).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, yf.sum(axis=0), (yf * yf).sum(axis=0)
+
+
+def _inputs(m=24, k=16, n=8, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 6)
+    x = jax.random.normal(ks[0], (m, k), dtype)
+    w = (jax.random.normal(ks[1], (k, n)) * 0.3).astype(dtype)
+    mu = jax.random.normal(ks[2], (k,)) * 0.2
+    var = jax.random.uniform(ks[3], (k,), minval=0.25, maxval=2.0)
+    inv = jax.lax.rsqrt(var)
+    gamma = jax.random.normal(ks[4], (k,)) * 0.3 + 1.0
+    beta = jax.random.normal(ks[5], (k,)) * 0.1
+    return x, mu, inv, gamma, beta, w
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("relu,bn", [(True, True), (False, True),
+                                     (False, False)])
+def test_forward_matches_reference(relu, bn):
+    x, mu, inv, gamma, beta, w = _inputs()
+    y, s, ss = flb.bn_linear_stats(x, mu, inv, gamma, beta, w, relu, bn)
+    yr, sr, ssr = _ref(x, mu, inv, gamma, beta, w, relu, bn)
+    np.testing.assert_allclose(y, yr, atol=1e-5)
+    np.testing.assert_allclose(s, sr, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(ss, ssr, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("relu,bn", [(True, True), (False, True),
+                                     (False, False)])
+def test_gradients_match_reference(relu, bn):
+    x, mu, inv, gamma, beta, w = _inputs(seed=1)
+    # Weighted sums touch all three outputs so dy, ds and dss are all
+    # nonzero — the ds/dss folding is the novel part of the backward.
+    ky = jax.random.split(jax.random.key(7), 3)
+    wy = jax.random.normal(ky[0], (24, 8))
+    ws_ = jax.random.normal(ky[1], (8,))
+    wss = jax.random.normal(ky[2], (8,)) * 0.01
+
+    def loss(f):
+        def inner(x, mu, inv, gamma, beta, w):
+            y, s, ss = f(x, mu, inv, gamma, beta, w, relu, bn)
+            return (jnp.sum(y.astype(jnp.float32) * wy)
+                    + jnp.sum(s * ws_) + jnp.sum(ss * wss))
+        return inner
+
+    gf = jax.grad(loss(flb.bn_linear_stats), argnums=tuple(range(6)))(
+        x, mu, inv, gamma, beta, w)
+    gr = jax.grad(loss(_ref), argnums=tuple(range(6)))(
+        x, mu, inv, gamma, beta, w)
+    names = ("dx", "dmu", "dinv", "dgamma", "dbeta", "dw")
+    for a, b, name in zip(gf, gr, names):
+        if not bn and name in ("dmu", "dinv", "dgamma", "dbeta"):
+            continue  # op contract: zeros for unused vector inputs
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.core
+def test_linear_stats_wrapper():
+    x, _, _, _, _, w = _inputs(seed=2)
+    y, s, ss = flb.linear_stats(x, w)
+    yr = jnp.dot(x, w)
+    np.testing.assert_allclose(y, yr, atol=1e-5)
+    np.testing.assert_allclose(s, yr.sum(axis=0), rtol=1e-5, atol=1e-4)
+
+
+def test_bf16_storage_stats_match_next_layer_view():
+    """Σy/Σy² must describe y as the next layer will read it (bf16)."""
+    x, mu, inv, gamma, beta, w = _inputs(dtype=jnp.bfloat16, seed=3)
+    y, s, ss = flb.bn_linear_stats(x, mu, inv, gamma, beta, w, True, True)
+    yf = np.asarray(y, np.float32)
+    np.testing.assert_allclose(s, yf.sum(axis=0), rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(ss, (yf * yf).sum(axis=0), rtol=1e-3,
+                               atol=1e-2)
+
+
+def test_chained_two_layers_matches_unfused():
+    """The intended usage: layer2's μ/inv derive from layer1's s/ss, so
+    gradients flow through the epilogue sums into BOTH layers."""
+    m, k, n1, n2 = 32, 16, 8, 8
+    ks = jax.random.split(jax.random.key(11), 4)
+    x = jax.random.normal(ks[0], (m, k))
+    w1 = (jax.random.normal(ks[1], (k, n1)) * 0.3)
+    w2 = (jax.random.normal(ks[2], (n1, n2)) * 0.3)
+    gamma = jnp.ones((n1,))
+    beta = jnp.zeros((n1,))
+    tgt = jax.random.normal(ks[3], (m, n2))
+    eps = 1e-5
+
+    def fused(params):
+        w1, w2, gamma, beta = params
+        zk = jnp.zeros((k,), jnp.float32)
+        y1, s1, ss1 = flb.bn_linear_stats(x, zk, zk, zk, zk, w1,
+                                          False, False)
+        mu = s1 / m
+        var = jnp.maximum(ss1 / m - mu * mu, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        y2, _, _ = flb.bn_linear_stats(y1, mu, inv, gamma, beta, w2,
+                                       True, True)
+        return jnp.mean((y2.astype(jnp.float32) - tgt) ** 2)
+
+    def unfused(params):
+        w1, w2, gamma, beta = params
+        y1 = jnp.dot(x, w1)
+        mu = y1.mean(axis=0)
+        var = jnp.maximum((y1 * y1).mean(axis=0) - mu * mu, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        a = jnp.maximum((y1 - mu) * (inv * gamma) + beta, 0.0)
+        y2 = jnp.dot(a, w2)
+        return jnp.mean((y2 - tgt) ** 2)
+
+    params = (w1, w2, gamma, beta)
+    lf, gf = jax.value_and_grad(fused)(params)
+    lr, gr = jax.value_and_grad(unfused)(params)
+    np.testing.assert_allclose(lf, lr, rtol=1e-5)
+    for a, b, name in zip(gf, gr, ("dw1", "dw2", "dgamma", "dbeta")):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
+                                   err_msg=name)

@@ -64,6 +64,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fused-bn", action="store_true", default=None,
                    help="Pallas fused BN(+residual)+ReLU kernels for CNNs "
                         "(ops/fused_batchnorm.py)")
+    p.add_argument("--fused-block", action="store_true", default=None,
+                   help="conv-epilogue fusion: bottleneck 1x1 convs as "
+                        "Pallas matmul+BN (ops/fused_linear_bn.py; "
+                        "resnet50/101/152)")
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatch count for *_pp models; the fill/"
                         "drain bubble wastes (P-1)/(M+P-1) of each step, so "
@@ -183,6 +187,8 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(remat=True)
     if args.fused_bn:
         cfg = cfg.replace(fused_bn=True)
+    if args.fused_block:
+        cfg = cfg.replace(fused_block=True)
     if args.pp_microbatches is not None:
         cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
 

@@ -122,19 +122,28 @@ def build(config: TrainConfig, total_steps: int):
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,
         steps_per_epoch(config))
-    if (spec.input_kind == "image" and config.grad_accum_steps > 1
-            and config.per_device_batch // config.grad_accum_steps < 32
-            and jax.process_index() == 0):
+    bn_batch = config.per_device_batch // max(config.grad_accum_steps, 1)
+    if (spec.input_kind == "image" and jax.process_index() == 0
+            and (bn_batch == 1
+                 or (config.grad_accum_steps > 1 and bn_batch < 32))):
         import warnings
 
         # warnings.warn (not a raw stderr print): dedupes across repeat
         # builds and lets deliberate small-batch harnesses filter it.
+        # bn_batch == 1 is a measured failure mode, not hypothetical:
+        # single-sample BN with a 1x1 final feature map normalizes every
+        # feature to exactly beta, collapsing logits to uniform (loss pins
+        # at ln(num_classes), BN grads go to zero). Per-shard BN is
+        # intentional (per-GPU BN under Horovod); the fix is a bigger
+        # per-shard batch, not synced statistics.
+        detail = ("training can silently stall at uniform logits; increase "
+                  "--batch-size or reduce the data-parallel axis"
+                  if bn_batch == 1 else "consider lowering --accum")
         warnings.warn(
-            f"BatchNorm statistics will be computed over only "
-            f"{config.per_device_batch // config.grad_accum_steps} examples "
-            f"per microbatch (per_device_batch={config.per_device_batch}, "
-            f"grad_accum_steps={config.grad_accum_steps}); consider "
-            f"lowering --accum", UserWarning, stacklevel=2)
+            f"BatchNorm statistics will be computed over only {bn_batch} "
+            f"example(s) (per_device_batch={config.per_device_batch}, "
+            f"grad_accum_steps={config.grad_accum_steps}); {detail}",
+            UserWarning, stacklevel=2)
     rng = jax.random.key(config.seed)
 
     seq_dim = 1 if spec.input_kind == "tokens" else None

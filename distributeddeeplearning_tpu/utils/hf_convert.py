@@ -1,0 +1,191 @@
+"""HuggingFace checkpoint → framework params (BERT / GPT-2 / Llama).
+
+The mapping that tests/test_hf_parity.py proves logit-exact, packaged for
+reuse: `tools/import_hf.py` turns a local HF checkpoint directory into an
+orbax checkpoint that `train.py --eval-only`, `generate.py`, and resumed
+training all consume. Functions take a ``{name: numpy array}`` state dict
+(use :func:`state_dict_to_numpy` on a torch state_dict), so this module
+never imports torch/transformers itself.
+
+Weight-layout conventions handled here:
+- torch ``nn.Linear`` stores (out, in) → transpose to our (in, out) kernels;
+- GPT-2's Conv1D already stores (in, out) → no transpose, and its fused
+  c_attn splits into query/key/value thirds;
+- Llama per-projection weights transpose; GQA K/V keep their narrower
+  (kv_heads·head_dim) width;
+- BERT's tied MLM decoder reuses word_embeddings, so only the transform,
+  LayerNorm, and output bias are mapped for the head.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Mapping
+
+
+def state_dict_to_numpy(sd: Mapping[str, Any]) -> dict:
+    """torch state_dict → plain numpy dict (the input everything here takes)."""
+    return {k: v.detach().cpu().numpy() for k, v in sd.items()}
+
+
+def _dense_t(sd, prefix):
+    """torch nn.Linear (out,in) → flax {'kernel': (in,out), 'bias'}."""
+    out = {"kernel": sd[prefix + ".weight"].T}
+    if prefix + ".bias" in sd:
+        out["bias"] = sd[prefix + ".bias"]
+    return out
+
+
+def _ln(sd, prefix):
+    return {"scale": sd[prefix + ".weight"], "bias": sd[prefix + ".bias"]}
+
+
+def llama_params_from_hf(sd: Mapping[str, Any], num_layers: int) -> dict:
+    """transformers.LlamaForCausalLM state dict → models/llama.py params."""
+
+    def layer(i):
+        p = f"model.layers.{i}."
+        return {
+            "attention_norm": {"scale": sd[p + "input_layernorm.weight"]},
+            "mlp_norm": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "attention": {
+                "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T},
+                "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T},
+                "v_proj": {"kernel": sd[p + "self_attn.v_proj.weight"].T},
+                "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
+            },
+            "gate_proj": {"kernel": sd[p + "mlp.gate_proj.weight"].T},
+            "up_proj": {"kernel": sd[p + "mlp.up_proj.weight"].T},
+            "down_proj": {"kernel": sd[p + "mlp.down_proj.weight"].T},
+        }
+
+    params = {
+        "embed_tokens": sd["model.embed_tokens.weight"],
+        "final_norm": {"scale": sd["model.norm.weight"]},
+        **{f"layer{i}": layer(i) for i in range(num_layers)},
+    }
+    # tie_word_embeddings models (TinyLlama-1.1B chat variants, etc.) have
+    # no separate lm_head tensor; ours always materializes the head kernel.
+    head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    params["lm_head"] = {"kernel": head.T}
+    return params
+
+
+def gpt2_params_from_hf(sd: Mapping[str, Any], num_layers: int) -> dict:
+    """transformers.GPT2LMHeadModel state dict → models/gpt.py params.
+
+    HF GPT-2 uses Conv1D ((in, out) weights — NOT transposed)."""
+
+    def layer(i):
+        p = f"transformer.h.{i}."
+        qkv_w = sd[p + "attn.c_attn.weight"]
+        qkv_b = sd[p + "attn.c_attn.bias"]
+        h = qkv_w.shape[0]
+        return {
+            "ln1": _ln(sd, p + "ln_1"),
+            "ln2": _ln(sd, p + "ln_2"),
+            "attention": {
+                "query": {"kernel": qkv_w[:, :h], "bias": qkv_b[:h]},
+                "key": {"kernel": qkv_w[:, h:2 * h],
+                        "bias": qkv_b[h:2 * h]},
+                "value": {"kernel": qkv_w[:, 2 * h:], "bias": qkv_b[2 * h:]},
+                "output": {"kernel": sd[p + "attn.c_proj.weight"],
+                           "bias": sd[p + "attn.c_proj.bias"]},
+            },
+            "mlp_in": {"kernel": sd[p + "mlp.c_fc.weight"],
+                       "bias": sd[p + "mlp.c_fc.bias"]},
+            "mlp_out": {"kernel": sd[p + "mlp.c_proj.weight"],
+                        "bias": sd[p + "mlp.c_proj.bias"]},
+        }
+
+    return {
+        "wte": sd["transformer.wte.weight"],
+        "wpe": sd["transformer.wpe.weight"],
+        "ln_f": _ln(sd, "transformer.ln_f"),
+        **{f"layer{i}": layer(i) for i in range(num_layers)},
+    }
+
+
+def bert_params_from_hf(sd: Mapping[str, Any], num_layers: int) -> dict:
+    """transformers.BertForMaskedLM state dict → models/bert.py params."""
+
+    def layer(i):
+        p = f"bert.encoder.layer.{i}."
+        return {
+            "attention": {
+                "query": _dense_t(sd, p + "attention.self.query"),
+                "key": _dense_t(sd, p + "attention.self.key"),
+                "value": _dense_t(sd, p + "attention.self.value"),
+                "output": _dense_t(sd, p + "attention.output.dense"),
+            },
+            "attention_ln": _ln(sd, p + "attention.output.LayerNorm"),
+            "intermediate": _dense_t(sd, p + "intermediate.dense"),
+            "mlp_output": _dense_t(sd, p + "output.dense"),
+            "mlp_ln": _ln(sd, p + "output.LayerNorm"),
+        }
+
+    return {
+        "word_embeddings": sd["bert.embeddings.word_embeddings.weight"],
+        "position_embeddings": sd[
+            "bert.embeddings.position_embeddings.weight"],
+        "type_embeddings": sd["bert.embeddings.token_type_embeddings.weight"],
+        "embeddings_ln": _ln(sd, "bert.embeddings.LayerNorm"),
+        "mlm_transform": _dense_t(sd, "cls.predictions.transform.dense"),
+        "mlm_ln": _ln(sd, "cls.predictions.transform.LayerNorm"),
+        "mlm_bias": sd["cls.predictions.bias"],
+        **{f"layer{i}": layer(i) for i in range(num_layers)},
+    }
+
+
+# model_type (HF config.json) → (converter, num_layers config key)
+CONVERTERS: dict[str, tuple[Callable, str]] = {
+    "llama": (llama_params_from_hf, "num_hidden_layers"),
+    "gpt2": (gpt2_params_from_hf, "n_layer"),
+    "bert": (bert_params_from_hf, "num_hidden_layers"),
+}
+
+# Tensors a checkpoint may carry that the mapping legitimately does not
+# consume: tied-weight duplicates (same storage as the mapped tensor) and
+# non-parameter buffers (causal-mask and position-id caches).
+_IGNORABLE = re.compile(
+    r"(^|\.)(lm_head\.weight"               # tied head duplicate
+    r"|cls\.predictions\.decoder\.(weight|bias)"  # BERT tied decoder
+    r"|.*attn\.(masked_)?bias"              # GPT-2 causal-mask buffers
+    r"|.*\.position_ids"                    # legacy BERT buffer
+    r"|.*rotary_emb\.inv_freq)$")           # legacy Llama RoPE buffer
+
+
+class _TrackedDict(dict):
+    """Records key reads so :func:`convert_checked` can detect weights the
+    mapping silently dropped (e.g. bias tensors from attention_bias=True
+    checkpoints our architectures don't have)."""
+
+    def __init__(self, data):
+        super().__init__(data)
+        self.accessed: set = set()
+
+    def __getitem__(self, k):
+        self.accessed.add(k)
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        self.accessed.add(k)
+        return super().get(k, default)
+
+
+def convert_checked(family: str, sd: Mapping[str, Any],
+                    num_layers: int) -> dict:
+    """Run the family converter and FAIL LOUDLY on unconsumed weights —
+    a silently dropped tensor means the imported model computes something
+    different from the source checkpoint."""
+    convert, _ = CONVERTERS[family]
+    tracked = _TrackedDict(sd)
+    params = convert(tracked, num_layers)
+    leftover = {k for k in tracked if k not in tracked.accessed
+                and not _IGNORABLE.search(k)}
+    if leftover:
+        raise ValueError(
+            f"{family} checkpoint has {len(leftover)} tensor(s) the "
+            f"architecture mapping does not consume (the import would "
+            f"silently change the model): {sorted(leftover)[:8]}")
+    return params

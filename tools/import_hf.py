@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Import a local HuggingFace checkpoint into a framework checkpoint.
+
+    python tools/import_hf.py --hf-dir /path/to/hf_model --out ckpt/imported \
+        [--family llama|gpt2|bert]
+
+Reads the HF model with transformers (torch CPU, local files only — this
+environment has no network egress, which is also why imports take a
+directory, not a hub name), maps the weights through
+utils/hf_convert.py (the mapping tests/test_hf_parity.py proves
+logit-exact), and writes an orbax step-0 checkpoint whose ``params``
+subtree matches the corresponding framework model — consumable by
+``generate.py --checkpoint-dir``, ``train.py --eval-only``, or as a
+finetune starting point with ``--resume``.
+
+Prints one JSON line: the family, layer/param counts, and the framework
+model constructor kwargs that reproduce the architecture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.utils import hf_convert
+
+
+def model_kwargs(family: str, cfg) -> dict:
+    """Framework model-constructor kwargs mirroring the HF architecture —
+    what a user passes to models/{llama,gpt,bert}.py to load the import."""
+    if family == "llama":
+        # Options our Llama implementation does not have: reject rather
+        # than import a checkpoint that would compute something different.
+        for opt in ("attention_bias", "mlp_bias"):
+            if getattr(cfg, opt, False):
+                raise SystemExit(f"unsupported llama option {opt}=True")
+        if getattr(cfg, "rope_scaling", None):
+            raise SystemExit("unsupported llama option rope_scaling="
+                             f"{cfg.rope_scaling!r}")
+        return dict(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                    num_layers=cfg.num_hidden_layers,
+                    num_heads=cfg.num_attention_heads,
+                    num_kv_heads=cfg.num_key_value_heads,
+                    intermediate_size=cfg.intermediate_size,
+                    rope_theta=cfg.rope_theta, rms_eps=cfg.rms_norm_eps)
+    if family == "gpt2":
+        return dict(vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
+                    num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                    max_position=cfg.n_positions)
+    if family == "bert":
+        return dict(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                    num_layers=cfg.num_hidden_layers,
+                    num_heads=cfg.num_attention_heads,
+                    intermediate_size=cfg.intermediate_size,
+                    max_position=cfg.max_position_embeddings,
+                    type_vocab_size=cfg.type_vocab_size,
+                    layer_norm_eps=cfg.layer_norm_eps)
+    raise SystemExit(f"unsupported family {family!r}; "
+                     f"supported: {sorted(hf_convert.CONVERTERS)}")
+
+
+def load_hf(hf_dir: str, family: str):
+    import transformers
+
+    loaders = {
+        "llama": transformers.LlamaForCausalLM,
+        "gpt2": transformers.GPT2LMHeadModel,
+        "bert": transformers.BertForMaskedLM,
+    }
+    model = loaders[family].from_pretrained(hf_dir, local_files_only=True)
+    return model.config, model.state_dict()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--hf-dir", required=True,
+                   help="local directory with config.json + weights")
+    p.add_argument("--out", required=True,
+                   help="checkpoint directory to write (orbax, step 0)")
+    p.add_argument("--family", default=None,
+                   choices=[None, *sorted(hf_convert.CONVERTERS)],
+                   help="architecture family; default: config.json "
+                        "model_type")
+    args = p.parse_args(argv)
+
+    with open(os.path.join(args.hf_dir, "config.json")) as f:
+        model_type = json.load(f).get("model_type", "")
+    family = args.family or model_type
+    if family not in hf_convert.CONVERTERS:
+        raise SystemExit(f"unsupported model_type {model_type!r}; "
+                         f"supported: {sorted(hf_convert.CONVERTERS)}")
+
+    cfg, sd = load_hf(args.hf_dir, family)
+    kwargs = model_kwargs(family, cfg)  # validates unsupported options
+    _, layers_key = hf_convert.CONVERTERS[family]
+    params = hf_convert.convert_checked(
+        family, hf_convert.state_dict_to_numpy(sd), getattr(cfg, layers_key))
+
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(os.path.abspath(args.out))
+    # The {params, batch_stats, step} layout Checkpointer's partial
+    # restores expect (restore_latest_params / restore_latest_for_eval).
+    mgr.save(0, args=ocp.args.StandardSave(
+        {"params": params, "batch_stats": None, "step": 0}))
+    mgr.wait_until_finished()
+    mgr.close()
+
+    n_params = sum(int(v.size) for v in
+                   __import__("jax").tree.leaves(params))
+    print(json.dumps({
+        "family": family, "layers": getattr(cfg, layers_key),
+        "param_count": n_params, "out": os.path.abspath(args.out),
+        "model_kwargs": kwargs,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+#!/bin/bash
+# Run every pending on-chip measurement in priority order, one log per step.
+# Usage: tools/chip_window.sh [results_dir]   (default .chip_results)
+# Each step gets a hard timeout so one hang can't burn the whole window;
+# steps append to RES so partial windows still leave evidence.
+set -u
+RES="${1:-.chip_results}"
+mkdir -p "$RES"
+cd "$(dirname "$0")/.."
+stamp() { date +%H:%M:%S; }
+
+echo "[$(stamp)] window open" >> "$RES/log.txt"
+
+# 1. Headline bench (refreshes compile cache for the driver's run).
+timeout 600 python bench.py > "$RES/bench_headline.json" 2>> "$RES/log.txt"
+echo "[$(stamp)] headline rc=$?" >> "$RES/log.txt"
+
+# 2. Acceptance-suite rows (all configs, one child process).
+timeout 1500 python bench.py --suite --budget 1400 \
+  > "$RES/bench_suite.json" 2>> "$RES/log.txt"
+echo "[$(stamp)] suite rc=$?" >> "$RES/log.txt"
+
+# 3. Fused-block step A/B vs unfused (the round-3 kernel project).
+timeout 900 python - > "$RES/fused_block_ab.json" 2>> "$RES/log.txt" <<'EOF'
+import json, sys, time
+sys.path.insert(0, ".")
+from distributeddeeplearning_tpu import data as datalib
+from distributeddeeplearning_tpu.config import (DataConfig, ParallelConfig,
+                                                TrainConfig)
+from distributeddeeplearning_tpu.models import model_spec
+from distributeddeeplearning_tpu.train import loop
+import jax
+
+def step_rate(batch, steps=20, **flags):
+    cfg = TrainConfig(model="resnet50", global_batch_size=batch,
+                      dtype="bfloat16", log_every=10**9,
+                      parallel=ParallelConfig(data=1),
+                      data=DataConfig(synthetic=True), **flags)
+    mesh, model, shd, state, train_step, _, rng = loop.build(cfg, 64)
+    src = datalib.make_source(cfg, "image", shd)
+    i, metrics = 0, None
+    for _ in range(5):
+        state, metrics = train_step(state, src.batch(i), rng); i += 1
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, src.batch(i), rng); i += 1
+    jax.device_get(metrics)
+    return batch * steps / (time.perf_counter() - t0)
+
+for batch in (256, 512):
+    try:
+        base = step_rate(batch)
+        fused = step_rate(batch, fused_block=True)
+        print(json.dumps({"check": "fused_block_ab", "batch": batch,
+                          "unfused": round(base, 1), "fused": round(fused, 1),
+                          "speedup": round(fused / base, 3)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"check": "fused_block_ab", "batch": batch,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+EOF
+echo "[$(stamp)] fused_block rc=$?" >> "$RES/log.txt"
+
+# 4. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).
+timeout 600 python - > "$RES/matmul_micro.json" 2>> "$RES/log.txt" <<'EOF'
+import json, sys, time
+sys.path.insert(0, ".")
+import jax, jax.numpy as jnp
+from distributeddeeplearning_tpu.ops.fused_linear_bn import linear_stats
+
+def t(f, *a):
+    r = jax.jit(f)
+    out = r(*a)
+    jax.tree.map(lambda x: x.block_until_ready(), out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = r(*a)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    return (time.perf_counter() - t0) / 10
+
+# (M, K, N) of resnet50 b256 1x1 convs: layer1 c3, layer2 c3, layer3 c3.
+for m, k, n in ((802816, 64, 256), (200704, 128, 512), (50176, 256, 1024),
+                (200704, 512, 256)):
+    x = jax.random.normal(jax.random.key(0), (m, k), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(1), (k, n), jnp.bfloat16)
+    xla = t(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32
+                                 ).astype(jnp.bfloat16), x, w)
+    pls = t(lambda a, b: linear_stats(a, b)[0], x, w)
+    tf_ = 2 * m * k * n
+    print(json.dumps({"mkn": [m, k, n],
+                      "xla_ms": round(xla * 1e3, 2),
+                      "pallas_stats_ms": round(pls * 1e3, 2),
+                      "xla_tflops": round(tf_ / xla / 1e12, 1),
+                      "pallas_tflops": round(tf_ / pls / 1e12, 1)}),
+          flush=True)
+EOF
+echo "[$(stamp)] matmul_micro rc=$?" >> "$RES/log.txt"
+
+# 5. Profile the fused-block step (where does its time go).
+timeout 600 python tools/profile_step.py --model resnet50 --batch-size 256 \
+  --fused-block --top 25 > "$RES/profile_fused_block.json" 2>> "$RES/log.txt"
+echo "[$(stamp)] profile rc=$?" >> "$RES/log.txt"
+echo "[$(stamp)] window done" >> "$RES/log.txt"

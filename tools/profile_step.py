@@ -50,6 +50,7 @@ def run_and_trace(args, log_dir: str) -> None:
     cfg = TrainConfig(
         model=args.model, global_batch_size=args.batch_size * n_dev,
         dtype="bfloat16", log_every=10**9, fused_bn=args.fused_bn,
+        fused_block=args.fused_block,
         attention_impl=args.attention_impl, remat=args.remat,
         parallel=ParallelConfig(data=n_dev), data=data)
     mesh, model, batch_shd, state, train_step, sched, rng = loop.build(
@@ -150,6 +151,7 @@ def main(argv=None) -> int:
     p.add_argument("--attention-impl", default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--fused-bn", action="store_true")
+    p.add_argument("--fused-block", action="store_true")
     p.add_argument("--warmup", type=int, default=4)
     p.add_argument("--steps", type=int, default=6)
     p.add_argument("--top", type=int, default=25)
@@ -164,6 +166,7 @@ def main(argv=None) -> int:
     out["model"] = args.model
     out["batch_per_chip"] = args.batch_size
     out["fused_bn"] = args.fused_bn
+    out["fused_block"] = args.fused_block
     out["wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(out), flush=True)
     return 0

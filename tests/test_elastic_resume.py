@@ -57,7 +57,6 @@ def _assert_trees_close(a, b, atol=1e-6):
             err_msg=jax.tree_util.keystr(path))
 
 
-@pytest.mark.core
 @pytest.mark.usefixtures("devices8")
 def test_dp8_checkpoint_resumes_on_dp4_exactly(tmp_path):
     """Save at dp=8, resume at dp=4: same trajectory as uninterrupted dp=8

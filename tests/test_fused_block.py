@@ -45,7 +45,6 @@ def test_variable_trees_identical(shared):
         assert a.shape == b.shape and a.dtype == b.dtype, pa
 
 
-@pytest.mark.core
 def test_forward_and_stats_match(shared):
     x, variables = shared
     yu, su = _tiny(False).apply(variables, x, train=True,

@@ -4,21 +4,22 @@
 # Each step gets a hard timeout so one hang can't burn the whole window;
 # steps append to RES so partial windows still leave evidence.
 set -u
-RES="${1:-.chip_results}"
-mkdir -p "$RES"
 cd "$(dirname "$0")/.."
+RES="$(realpath -m "${1:-.chip_results}")"  # absolute: survives the cd above
+mkdir -p "$RES"
 stamp() { date +%H:%M:%S; }
+note() { rc=$?; echo "[$(stamp)] $1 rc=$rc" >> "$RES/log.txt"; }
 
 echo "[$(stamp)] window open" >> "$RES/log.txt"
 
 # 1. Headline bench (refreshes compile cache for the driver's run).
 timeout 600 python bench.py > "$RES/bench_headline.json" 2>> "$RES/log.txt"
-echo "[$(stamp)] headline rc=$?" >> "$RES/log.txt"
+note headline
 
 # 2. Acceptance-suite rows (all configs, one child process).
 timeout 1500 python bench.py --suite --budget 1400 \
   > "$RES/bench_suite.json" 2>> "$RES/log.txt"
-echo "[$(stamp)] suite rc=$?" >> "$RES/log.txt"
+note suite
 
 # 3. Fused-block step A/B vs unfused (the round-3 kernel project).
 timeout 900 python - > "$RES/fused_block_ab.json" 2>> "$RES/log.txt" <<'EOF'
@@ -60,7 +61,7 @@ for batch in (256, 512):
                           "error": f"{type(e).__name__}: {e}"[:300]}),
               flush=True)
 EOF
-echo "[$(stamp)] fused_block rc=$?" >> "$RES/log.txt"
+note fused_block
 
 # 4. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).
 timeout 600 python - > "$RES/matmul_micro.json" 2>> "$RES/log.txt" <<'EOF'
@@ -95,10 +96,10 @@ for m, k, n in ((802816, 64, 256), (200704, 128, 512), (50176, 256, 1024),
                       "pallas_tflops": round(tf_ / pls / 1e12, 1)}),
           flush=True)
 EOF
-echo "[$(stamp)] matmul_micro rc=$?" >> "$RES/log.txt"
+note matmul_micro
 
 # 5. Profile the fused-block step (where does its time go).
 timeout 600 python tools/profile_step.py --model resnet50 --batch-size 256 \
   --fused-block --top 25 > "$RES/profile_fused_block.json" 2>> "$RES/log.txt"
-echo "[$(stamp)] profile rc=$?" >> "$RES/log.txt"
+note profile
 echo "[$(stamp)] window done" >> "$RES/log.txt"

@@ -136,6 +136,8 @@ class TrainConfig:
     fused_bn: bool = False        # Pallas fused BN+ReLU kernels (CNNs)
     fused_block: bool = False     # conv-epilogue fusion: bottleneck 1x1
                                   # convs as Pallas matmul+BN (resnet50+)
+    sync_bn: bool = False         # cross-replica BN statistics (psum over
+                                  # the data axis; torch SyncBatchNorm)
     # GPipe microbatch count for *_pp models (None = model default). The
     # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
     # under ~20% (tools/bench_parallel_overhead.py measures this).

@@ -42,6 +42,9 @@ class DenseNet(nn.Module):
     num_init_features: int = 64
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    # SyncBN: mesh axis name(s) for cross-replica statistics (pmean),
+    # bound only inside the shard_map DP step; None = per-shard BN.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -52,7 +55,8 @@ class DenseNet(nn.Module):
             padding="SAME")
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name if train else None)
 
         x = jnp.asarray(x, self.dtype)
         # Explicit (3,3) stem padding: torch-symmetric, like models/resnet.py
@@ -89,9 +93,13 @@ class DenseNet(nn.Module):
         return jnp.asarray(x, jnp.float32)
 
 
-def densenet121(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> DenseNet:
-    return DenseNet([6, 12, 24, 16], num_classes=num_classes, dtype=dtype)
+def densenet121(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+                bn_axis_name: Any = None) -> DenseNet:
+    return DenseNet([6, 12, 24, 16], num_classes=num_classes, dtype=dtype,
+                    bn_axis_name=bn_axis_name)
 
 
-def densenet169(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> DenseNet:
-    return DenseNet([6, 12, 32, 32], num_classes=num_classes, dtype=dtype)
+def densenet169(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+                bn_axis_name: Any = None) -> DenseNet:
+    return DenseNet([6, 12, 32, 32], num_classes=num_classes, dtype=dtype,
+                    bn_axis_name=bn_axis_name)

@@ -74,10 +74,17 @@ class FusedBottleneckBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     momentum: float = 0.9
     epsilon: float = 1e-5
+    # SyncBN: mesh axis name(s) to pmean statistics over (shard_map DP
+    # path only). The epilogue sums are per-shard; syncing is two (C,)
+    # pmeans per BN — negligible next to the gradient allreduce.
+    axis_name: Any = None
 
     def _stats(self, s, ss, m: int):
-        mean = s / m
-        var = jnp.maximum(ss / m - mean * mean, 0.0)
+        mean, ex2 = s / m, ss / m
+        if self.axis_name is not None:
+            mean = jax.lax.pmean(mean, self.axis_name)
+            ex2 = jax.lax.pmean(ex2, self.axis_name)
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
         return mean, var
 
     def _update_running(self, ra_mean, ra_var, mean, var):
@@ -145,8 +152,8 @@ class FusedBottleneckBlock(nn.Module):
         # bn2 statistics: one XLA multi-output reduce over y2 (its apply
         # pass is what conv3's prologue absorbs).
         y2f = y2d.astype(jnp.float32)
-        mean2 = y2f.mean(axis=0)
-        var2 = jnp.maximum((y2f * y2f).mean(axis=0) - mean2 * mean2, 0.0)
+        mean2, var2 = self._stats(y2f.sum(axis=0), (y2f * y2f).sum(axis=0),
+                                  m2)
         self._update_running(rm2, rv2, mean2, var2)
         inv2 = jax.lax.rsqrt(var2 + eps)
 

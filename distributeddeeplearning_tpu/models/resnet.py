@@ -101,6 +101,11 @@ class ResNet(nn.Module):
     # bn2's apply in conv3's prologue (models/fused_block.py). Bottleneck
     # nets only; variable-compatible with the unfused path.
     fused_block: bool = False
+    # Cross-replica BatchNorm (torch SyncBatchNorm semantics): mesh axis
+    # name(s) to pmean the batch statistics over. Only meaningful inside
+    # the shard_map DP train step, where those axes are bound; None keeps
+    # the default per-shard statistics (per-GPU BN under Horovod).
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -116,6 +121,11 @@ class ResNet(nn.Module):
             ``fused_bn``, the classic composition otherwise. Both create
             identical variables under ``name``."""
             if self.fused_bn:
+                if self.bn_axis_name is not None:
+                    raise ValueError(
+                        "sync_bn is not supported with fused_bn (the fused "
+                        "kernel computes statistics inside its custom VJP); "
+                        "use --sync-bn with the default BN or --fused-block")
                 from distributeddeeplearning_tpu.ops.fused_batchnorm import (
                     FusedBatchNormAct)
                 return FusedBatchNormAct(
@@ -125,6 +135,7 @@ class ResNet(nn.Module):
             y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
                              param_dtype=jnp.float32, scale_init=scale_init,
+                             axis_name=self.bn_axis_name if train else None,
                              name=name)(y)
             if residual is not None:
                 y = y + residual
@@ -151,7 +162,8 @@ class ResNet(nn.Module):
                         import FusedBottleneckBlock
                     x = FusedBottleneckBlock(
                         filters=self.width * 2 ** i, strides=strides,
-                        dtype=self.dtype, name=name)(x, train=train)
+                        dtype=self.dtype, axis_name=self.bn_axis_name,
+                        name=name)(x, train=train)
                 else:
                     x = self.block(filters=self.width * 2 ** i,
                                    strides=strides, conv=conv,
@@ -166,48 +178,62 @@ class ResNet(nn.Module):
 
 
 def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn, fused_block=fused_block)
+                  fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet18_thin(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-                  fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+                  fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     """Width-16 ResNet-18 (1/16th the conv FLOPs): the CPU-tractable stand-in
     for convergence-recipe demonstrations (tools/convergence_lars.py) and
     fast tests — same depth, blocks, and BN structure as the real thing."""
     return ResNet([2, 2, 2, 2], BasicBlock, num_classes, width=16,
-                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block)
+                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet26_thin(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-                  fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+                  fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     """Width-16 bottleneck ResNet-26 ([2,2,2,2] Bottleneck): the
     CPU-tractable stand-in with the SAME block structure as resnet50 —
     what fused_block tests and bottleneck recipe demos run on."""
     return ResNet([2, 2, 2, 2], BottleneckBlock, num_classes, width=16,
-                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block)
+                  dtype=dtype, fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet34(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn, fused_block=fused_block)
+                  fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn, fused_block=fused_block)
+                  fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn, fused_block=fused_block)
+                  fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)
 
 
 def resnet152(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-            fused_bn: bool = False, fused_block: bool = False) -> ResNet:
+            fused_bn: bool = False, fused_block: bool = False,
+            bn_axis_name: Any = None) -> ResNet:
     return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes, dtype=dtype,
-                  fused_bn=fused_bn, fused_block=fused_block)
+                  fused_bn=fused_bn, fused_block=fused_block,
+                  bn_axis_name=bn_axis_name)

@@ -83,6 +83,19 @@ def build(config: TrainConfig, total_steps: int):
         kw["fused_bn"] = True
     if config.fused_block:
         kw["fused_block"] = True
+    if config.sync_bn:
+        # Cross-replica BN needs the named mesh axes of the explicit
+        # shard_map path; the GSPMD path has no manual axes to pmean over.
+        if uses_gspmd(config, spec.input_kind):
+            raise ValueError(
+                "sync_bn requires the pure-DP shard_map path (image model, "
+                "no tp/sp/fsdp axes); this config takes the GSPMD path")
+        import inspect
+        if "bn_axis_name" not in inspect.signature(spec.build).parameters:
+            raise ValueError(
+                f"--sync-bn: model {config.model!r} has no BatchNorm to "
+                f"synchronize (supported: resnet*/densenet* families)")
+        kw["bn_axis_name"] = steps.DATA_AXES
     if config.pipeline_microbatches:
         kw["pipeline_microbatches"] = config.pipeline_microbatches
     model = spec.build(**kw)
@@ -123,6 +136,10 @@ def build(config: TrainConfig, total_steps: int):
         config.optimizer, config.global_batch_size, total_steps,
         steps_per_epoch(config))
     bn_batch = config.per_device_batch // max(config.grad_accum_steps, 1)
+    if config.sync_bn:
+        # SyncBN pools statistics across the DP shards: the effective
+        # statistics batch is the whole (micro)batch, not the shard's.
+        bn_batch *= config.parallel.data * config.parallel.fsdp
     if (spec.input_kind == "image" and jax.process_index() == 0
             and (bn_batch == 1
                  or (config.grad_accum_steps > 1 and bn_batch < 32))):
@@ -137,7 +154,8 @@ def build(config: TrainConfig, total_steps: int):
         # intentional (per-GPU BN under Horovod); the fix is a bigger
         # per-shard batch, not synced statistics.
         detail = ("training can silently stall at uniform logits; increase "
-                  "--batch-size or reduce the data-parallel axis"
+                  "--batch-size, reduce the data-parallel axis, or pool "
+                  "statistics across shards with --sync-bn"
                   if bn_batch == 1 else "consider lowering --accum")
         warnings.warn(
             f"BatchNorm statistics will be computed over only {bn_batch} "

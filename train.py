@@ -68,6 +68,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="conv-epilogue fusion: bottleneck 1x1 convs as "
                         "Pallas matmul+BN (ops/fused_linear_bn.py; "
                         "resnet50/101/152)")
+    p.add_argument("--sync-bn", action="store_true", default=None,
+                   help="cross-replica BatchNorm statistics (psum over the "
+                        "data axis, torch SyncBatchNorm semantics; pure-DP "
+                        "CNN configs only)")
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatch count for *_pp models; the fill/"
                         "drain bubble wastes (P-1)/(M+P-1) of each step, so "
@@ -189,6 +193,8 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(fused_bn=True)
     if args.fused_block:
         cfg = cfg.replace(fused_block=True)
+    if args.sync_bn:
+        cfg = cfg.replace(sync_bn=True)
     if args.pp_microbatches is not None:
         cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
 

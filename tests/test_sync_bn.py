@@ -89,6 +89,17 @@ def test_sync_bn_fused_block_matches_unfused():
     lf, pf = _run_steps(_cfg(model="resnet26_thin", fused_block=True))
     lu, pu = _run_steps(_cfg(model="resnet26_thin", fused_block=False))
     np.testing.assert_allclose(lf, lu, rtol=1e-5, atol=1e-5)
+    # Param tolerance is deliberately loose: the two paths compute y with
+    # different reduction orders (1x1 conv vs matmul), and near-zero BN
+    # inputs amplified by inv = rsqrt(var) turn that rounding into ~5e-5
+    # per-step parameter drift (measured) — chaos, not error. The check
+    # still catches structural breakage (a dropped pmean diverges at 1e-2+
+    # and fails the loss assert above first).
+    for (path, a), b in zip(jax.tree_util.tree_leaves_with_path(pf),
+                            jax.tree_util.tree_leaves(pu)):
+        np.testing.assert_allclose(
+            a, b, atol=1e-3,
+            err_msg=jax.tree_util.keystr(path))
 
 
 @pytest.mark.usefixtures("devices8")

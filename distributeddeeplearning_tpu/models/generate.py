@@ -102,6 +102,81 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
     return ids
 
 
+def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
+                  num_beams: int = 4, length_penalty: float = 1.0,
+                  eos_id: Optional[int] = None, pad_id: int = 0):
+    """Beam-search decoding: (B, P) -> (B, P + max_new_tokens) int32.
+
+    Fixed-shape throughout (one compile): beams live as a flattened
+    (B*K, total) batch through the same padded forward the sampling path
+    uses, so every causal variant (dense/flash, GPT/Llama) works
+    unchanged. Per step, each batch row ranks its K*V candidate
+    extensions by accumulated log-probability and keeps the top K;
+    finished beams (emitted ``eos_id``) are frozen — they extend only
+    with ``pad_id`` at unchanged score. Final ranking divides scores by
+    (emitted length)**length_penalty (>1 favors longer hypotheses;
+    identical lengths make it a no-op). Deterministic: no RNG anywhere.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    b, p = prompt_ids.shape
+    k = num_beams
+    total = p + max_new_tokens
+    neg = jnp.float32(-1e9)
+
+    # (B, K, total) flattened to (B*K, total); beam 0 holds the prompt,
+    # beams 1..K-1 start dead so step 1 fans out from the prompt alone.
+    ids0 = jnp.full((b, k, total), pad_id, jnp.int32)
+    ids0 = ids0.at[:, :, :p].set(prompt_ids[:, None, :])
+    scores0 = jnp.full((b, k), neg).at[:, 0].set(0.0)
+    finished0 = jnp.zeros((b, k), bool)
+    mask0 = jnp.broadcast_to(
+        (jnp.arange(total)[None, :] < p).astype(jnp.int32), (b * k, total))
+
+    def step(carry, _):
+        ids, scores, finished, mask, pos = carry
+        logits = model.apply(variables, ids.reshape(b * k, total),
+                             attention_mask=mask, train=False)
+        next_logits = jax.lax.dynamic_slice_in_dim(
+            logits, pos - 1, 1, axis=1)[:, 0]              # (B*K, V)
+        logp = jax.nn.log_softmax(next_logits).reshape(b, k, -1)
+        v = logp.shape[-1]
+        if eos_id is not None:
+            # A finished beam contributes exactly one candidate: itself,
+            # extended by pad at unchanged score (scored on the pad lane).
+            frozen = jnp.full((b, k, v), neg).at[:, :, pad_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], frozen, logp)
+        cand = scores[:, :, None] + logp                   # (B, K, V)
+        top_scores, flat = jax.lax.top_k(cand.reshape(b, k * v), k)
+        beam_idx, tok = flat // v, (flat % v).astype(jnp.int32)
+        ids = jnp.take_along_axis(ids, beam_idx[:, :, None], axis=1)
+        ids = ids.at[:, :, pos].set(tok)
+        if eos_id is not None:
+            was_done = jnp.take_along_axis(finished, beam_idx, axis=1)
+            finished = was_done | (tok == eos_id)
+        mask = mask.reshape(b, k, total).at[:, :, pos].set(1)
+        return (ids, top_scores, finished, mask.reshape(b * k, total),
+                pos + 1), None
+
+    (ids, scores, finished, _, _), _ = jax.lax.scan(
+        step, (ids0, scores0, finished0, mask0, jnp.int32(p)), None,
+        length=max_new_tokens)
+
+    if eos_id is not None:
+        # Emitted length = tokens up to and including eos (or the full
+        # budget for unfinished beams).
+        gen = ids[:, :, p:]
+        is_eos = gen == eos_id
+        first_eos = jnp.argmax(is_eos, axis=-1)
+        length = jnp.where(is_eos.any(axis=-1), first_eos + 1,
+                           max_new_tokens)
+    else:
+        length = jnp.full((b, k), max_new_tokens)
+    norm = scores / jnp.maximum(length, 1).astype(
+        jnp.float32) ** jnp.float32(length_penalty)
+    best = jnp.argmax(norm, axis=1)
+    return jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
+
+
 def _generate_cached(model, variables, prompt_ids, *, total: int,
                      pad_id: int, sample, rng):
     """KV-cache decode: ONE batched prefill forward primes the cache with

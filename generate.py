@@ -33,6 +33,16 @@ def main(argv=None) -> int:
                    help="model context length (defaults to prompt+new)")
     p.add_argument("--vocab-size", type=int, default=None)
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--num-beams", type=int, default=0,
+                   help="beam-search decoding with this many beams "
+                        "(deterministic; overrides temperature/top-k; "
+                        "full-refeed path)")
+    p.add_argument("--length-penalty", type=float, default=1.0,
+                   help="beam scores divide by length**alpha (>1 favors "
+                        "longer hypotheses); only with --num-beams")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="end-of-sequence token id for beam search "
+                        "(finished beams freeze and pad)")
     p.add_argument("--use-cache", action="store_true",
                    help="KV-cache incremental decoding (GPT and Llama "
                         "families): O(S) per token instead of full-refeed "
@@ -48,7 +58,8 @@ def main(argv=None) -> int:
 
     from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
     from distributeddeeplearning_tpu.models import model_spec
-    from distributeddeeplearning_tpu.models.generate import generate
+    from distributeddeeplearning_tpu.models.generate import (
+        generate, generate_beam)
     from distributeddeeplearning_tpu.train import checkpoint as ckptlib
     from distributeddeeplearning_tpu.train import loop
 
@@ -87,10 +98,21 @@ def main(argv=None) -> int:
             f"no checkpoint in {args.checkpoint_dir!r}; refusing to sample "
             "from randomly initialized weights")
 
-    out = generate(model, {"params": params}, prompts,
-                   max_new_tokens=args.max_new_tokens,
-                   temperature=args.temperature, top_k=args.top_k,
-                   rng=jax.random.key(args.seed), use_cache=args.use_cache)
+    if args.num_beams > 0:
+        if args.use_cache:
+            raise SystemExit("--num-beams uses the full-refeed path; drop "
+                             "--use-cache")
+        out = generate_beam(model, {"params": params}, prompts,
+                            max_new_tokens=args.max_new_tokens,
+                            num_beams=args.num_beams,
+                            length_penalty=args.length_penalty,
+                            eos_id=args.eos_id)
+    else:
+        out = generate(model, {"params": params}, prompts,
+                       max_new_tokens=args.max_new_tokens,
+                       temperature=args.temperature, top_k=args.top_k,
+                       rng=jax.random.key(args.seed),
+                       use_cache=args.use_cache)
     for row in jax.device_get(out).tolist():
         print(json.dumps({"tokens": row}), flush=True)
     return 0

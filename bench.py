@@ -282,7 +282,11 @@ def _child(args) -> int:
                              protocol=f"w{row.quick_warmup + row.quick_steps}"
                                       f"+{row.steps} b{alt} sweep")
         return 0
+    wanted = (set(args.suite_models.split(","))
+              if args.suite_models else None)
     for model, overrides in SUITE:
+        if wanted is not None and model not in wanted:
+            continue
         row = copy.copy(args)
         row.model = model
         row.attention_impl, row.remat, row.fused_bn = None, False, False
@@ -450,6 +454,9 @@ def main(argv=None) -> int:
                         "primary measurement (comma list, 'none', or "
                         "'auto' = 256 for the resnet50 b512 headline); "
                         "an alternate line is emitted only if faster")
+    p.add_argument("--suite-models", default=None,
+                   help="with --suite: only measure rows whose model is "
+                        "in this comma list (re-run a single row)")
     p.add_argument("--suite", action="store_true",
                    help="measure every acceptance config, one line each")
     p.add_argument("--platform", default=None,
@@ -503,6 +510,8 @@ def main(argv=None) -> int:
         child_cmd += ["--fused-block"]
     if args.suite:
         child_cmd += ["--suite"]
+        if args.suite_models:
+            child_cmd += ["--suite-models", args.suite_models]
         args.attempt_timeout = max(args.attempt_timeout, args.budget)
 
     last_err = "no attempt ran"

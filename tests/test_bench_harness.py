@@ -1,0 +1,145 @@
+"""bench.py control flow with the measurement stubbed out — the suite
+loop, sweep emit-only-if-faster rule, per-row error records, and the
+last-good cache are driver-facing contracts that must not depend on a
+live chip to be tested."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "LAST_GOOD_PATH",
+                        str(tmp_path / "last_bench.json"))
+    return mod
+
+
+def _args(bench, extra=()):
+    # Parse exactly as main() would, then return the namespace.
+    import argparse  # noqa: F401
+    argv = ["--run-child", *extra]
+    # Reuse main's parser by intercepting _child.
+    ns = {}
+
+    def fake_child(a):
+        ns["args"] = a
+        return 0
+
+    bench._child, orig = fake_child, bench._child
+    try:
+        bench.main(argv)
+    finally:
+        bench._child = orig
+    return ns["args"]
+
+
+def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
+    seen = []
+
+    def fake_measure(row, emit_quick=True, emit_final=True):
+        seen.append((row.model, row.batch_size, row.attention_impl,
+                     row.remat))
+        if row.model == "densenet121":
+            raise RuntimeError("boom")  # must yield an error record
+        print(json.dumps({"metric": f"{row.model}_x", "value": 1.0}),
+              flush=True)
+        return 1.0
+
+    monkeypatch.setattr(bench, "_child_measure", fake_measure)
+    monkeypatch.setattr(bench, "jax", None, raising=False)
+    args = _args(bench, ["--suite", "--fused-bn", "--remat",
+                         "--suite-models",
+                         "resnet50,densenet121,bert_base"])
+
+    class FakeJax:
+        @staticmethod
+        def device_count():
+            return 1
+
+        @staticmethod
+        def devices():
+            class D:
+                platform = "cpu"
+            return [D()]
+
+        class config:
+            @staticmethod
+            def update(*a):
+                pass
+
+    sys.modules.setdefault("jax", FakeJax)  # _child imports jax
+    try:
+        rc = bench._child(args)
+    finally:
+        if sys.modules.get("jax") is FakeJax:
+            del sys.modules["jax"]
+    assert rc == 0
+    models = [s[0] for s in seen]
+    assert models == ["resnet50", "densenet121", "bert_base", "bert_base",
+                      "bert_base"]
+    # Suite rows must NOT inherit headline flags; row overrides apply.
+    assert all(s[3] is False for s in seen[:2])  # remat reset
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    errors = [r for r in out if r.get("value") is None]
+    assert len(errors) == 1 and "boom" in errors[0]["error"]
+
+
+def test_sweep_emits_only_if_faster(bench, monkeypatch, capsys):
+    rates = {512: 100.0, 256: 90.0, 128: 120.0}
+
+    def fake_measure(row, emit_quick=True, emit_final=True):
+        rate = rates[row.batch_size]
+        if emit_final:
+            bench._emit_metric(row, rate, protocol=f"b{row.batch_size}")
+        return rate
+
+    monkeypatch.setattr(bench, "_child_measure", fake_measure)
+    args = _args(bench, ["--model", "resnet50", "--sweep", "256,128"])
+
+    class FakeJax:
+        @staticmethod
+        def device_count():
+            return 1
+
+        @staticmethod
+        def devices():
+            class D:
+                platform = "cpu"
+            return [D()]
+
+        class config:
+            @staticmethod
+            def update(*a):
+                pass
+
+    sys.modules.setdefault("jax", FakeJax)
+    try:
+        bench._child(args)
+    finally:
+        if sys.modules.get("jax") is FakeJax:
+            del sys.modules["jax"]
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    # Primary (100) emitted; b256 (90) silent; b128 (120) emitted.
+    values = [r["value"] for r in out]
+    assert values == [100.0, 120.0]
+    assert "sweep" in out[-1]["protocol"]
+
+
+def test_last_good_cache_keyed_per_metric(bench, tmp_path):
+    bench._record_last_good(json.dumps({"metric": "a", "value": 1}))
+    bench._record_last_good(json.dumps({"metric": "b", "value": 2}))
+    bench._record_last_good(json.dumps({"metric": "a", "value": 3}))
+    with open(bench.LAST_GOOD_PATH) as f:
+        table = json.load(f)
+    assert table["a"]["value"] == 3 and table["b"]["value"] == 2

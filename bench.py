@@ -483,6 +483,14 @@ def main(argv=None) -> int:
     if args.suite and args.sweep not in ("auto", "none"):
         p.error("--sweep is a headline-run option; suite rows pin their "
                 "measured sweet-spot batches (see SUITE)")
+    if args.suite_models:
+        known = {m for m, _ in SUITE}
+        asked = {s.strip() for s in args.suite_models.split(",") if s.strip()}
+        if not asked or asked - known:
+            p.error(f"--suite-models: unknown model(s) "
+                    f"{sorted(asked - known) or args.suite_models!r}; "
+                    f"suite rows: {sorted(known)}")
+        args.suite_models = ",".join(sorted(asked))
 
     if args.run_child:
         return _child(args)

@@ -137,6 +137,111 @@ def bert_params_from_hf(sd: Mapping[str, Any], num_layers: int) -> dict:
     }
 
 
+def _flat(params: Mapping[str, Any]) -> dict:
+    from flax.traverse_util import flatten_dict
+
+    return flatten_dict(dict(params), sep="/")
+
+
+def llama_params_to_hf(params: Mapping[str, Any], num_layers: int) -> dict:
+    """models/llama.py params → transformers.LlamaForCausalLM state dict
+    (numpy values; the exact inverse of :func:`llama_params_from_hf`)."""
+    f = _flat(params)
+    sd = {
+        "model.embed_tokens.weight": f["embed_tokens"],
+        "model.norm.weight": f["final_norm/scale"],
+        "lm_head.weight": f["lm_head/kernel"].T,
+    }
+    for i in range(num_layers):
+        p, q = f"model.layers.{i}.", f"layer{i}/"
+        sd[p + "input_layernorm.weight"] = f[q + "attention_norm/scale"]
+        sd[p + "post_attention_layernorm.weight"] = f[q + "mlp_norm/scale"]
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[p + f"self_attn.{name}.weight"] = (
+                f[q + f"attention/{name}/kernel"].T)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + f"mlp.{name}.weight"] = f[q + f"{name}/kernel"].T
+    return sd
+
+
+def gpt2_params_to_hf(params: Mapping[str, Any], num_layers: int) -> dict:
+    """models/gpt.py params → transformers.GPT2LMHeadModel state dict
+    (Conv1D layout: no transposes; qkv re-fused)."""
+    import numpy as np
+
+    f = _flat(params)
+    sd = {
+        "transformer.wte.weight": f["wte"],
+        "transformer.wpe.weight": f["wpe"],
+        "transformer.ln_f.weight": f["ln_f/scale"],
+        "transformer.ln_f.bias": f["ln_f/bias"],
+        "lm_head.weight": f["wte"],  # tied head
+    }
+    for i in range(num_layers):
+        p, q = f"transformer.h.{i}.", f"layer{i}/"
+        for ln, ours in (("ln_1", "ln1"), ("ln_2", "ln2")):
+            sd[p + ln + ".weight"] = f[q + ours + "/scale"]
+            sd[p + ln + ".bias"] = f[q + ours + "/bias"]
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [f[q + "attention/query/kernel"], f[q + "attention/key/kernel"],
+             f[q + "attention/value/kernel"]], axis=1)
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [f[q + "attention/query/bias"], f[q + "attention/key/bias"],
+             f[q + "attention/value/bias"]])
+        sd[p + "attn.c_proj.weight"] = f[q + "attention/output/kernel"]
+        sd[p + "attn.c_proj.bias"] = f[q + "attention/output/bias"]
+        sd[p + "mlp.c_fc.weight"] = f[q + "mlp_in/kernel"]
+        sd[p + "mlp.c_fc.bias"] = f[q + "mlp_in/bias"]
+        sd[p + "mlp.c_proj.weight"] = f[q + "mlp_out/kernel"]
+        sd[p + "mlp.c_proj.bias"] = f[q + "mlp_out/bias"]
+    return sd
+
+
+def bert_params_to_hf(params: Mapping[str, Any], num_layers: int) -> dict:
+    """models/bert.py params → transformers.BertForMaskedLM state dict."""
+    f = _flat(params)
+    sd = {
+        "bert.embeddings.word_embeddings.weight": f["word_embeddings"],
+        "bert.embeddings.position_embeddings.weight":
+            f["position_embeddings"],
+        "bert.embeddings.token_type_embeddings.weight": f["type_embeddings"],
+        "bert.embeddings.LayerNorm.weight": f["embeddings_ln/scale"],
+        "bert.embeddings.LayerNorm.bias": f["embeddings_ln/bias"],
+        "cls.predictions.transform.dense.weight": f["mlm_transform/kernel"].T,
+        "cls.predictions.transform.dense.bias": f["mlm_transform/bias"],
+        "cls.predictions.transform.LayerNorm.weight": f["mlm_ln/scale"],
+        "cls.predictions.transform.LayerNorm.bias": f["mlm_ln/bias"],
+        "cls.predictions.bias": f["mlm_bias"],
+        # Tied decoder: transformers materializes these on load, but the
+        # saved form carries them for strict-load compatibility.
+        "cls.predictions.decoder.weight": f["word_embeddings"],
+        "cls.predictions.decoder.bias": f["mlm_bias"],
+    }
+    for i in range(num_layers):
+        p, q = f"bert.encoder.layer.{i}.", f"layer{i}/"
+        for hf_name, ours in (
+                ("attention.self.query", "attention/query"),
+                ("attention.self.key", "attention/key"),
+                ("attention.self.value", "attention/value"),
+                ("attention.output.dense", "attention/output"),
+                ("intermediate.dense", "intermediate"),
+                ("output.dense", "mlp_output")):
+            sd[p + hf_name + ".weight"] = f[q + ours + "/kernel"].T
+            sd[p + hf_name + ".bias"] = f[q + ours + "/bias"]
+        for hf_name, ours in (("attention.output.LayerNorm", "attention_ln"),
+                              ("output.LayerNorm", "mlp_ln")):
+            sd[p + hf_name + ".weight"] = f[q + ours + "/scale"]
+            sd[p + hf_name + ".bias"] = f[q + ours + "/bias"]
+    return sd
+
+
+EXPORTERS: dict[str, Callable] = {
+    "llama": llama_params_to_hf,
+    "gpt2": gpt2_params_to_hf,
+    "bert": bert_params_to_hf,
+}
+
+
 # model_type (HF config.json) → (converter, num_layers config key)
 CONVERTERS: dict[str, tuple[Callable, str]] = {
     "llama": (llama_params_from_hf, "num_hidden_layers"),

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Export a framework checkpoint to HuggingFace format (inverse of
+tools/import_hf.py).
+
+    python tools/export_hf.py --checkpoint-dir ckpt/run1 --model gpt2_small \
+        --out /data/exported [--family gpt2] [--vocab-size N] [--seq-len N]
+
+Restores the params subtree from the newest orbax checkpoint, inverts the
+weight mapping (utils/hf_convert.py EXPORTERS), loads it into a
+transformers model built from the matching config, and save_pretrained's
+it — so anything that consumes HF checkpoints (including our own import
+tool) can read a model fine-tuned here. Round-trip logit equality is
+test-pinned (tests/test_hf_parity.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.utils import hf_convert
+
+FAMILY_OF_MODEL = {"gpt2": "gpt2", "gpt": "gpt2", "bert": "bert",
+                   "llama": "llama", "tinyllama": "llama"}
+
+
+def _family(model_name: str, override):
+    if override:
+        return override
+    for prefix, fam in FAMILY_OF_MODEL.items():
+        if model_name.startswith(prefix):
+            return fam
+    raise SystemExit(f"cannot infer HF family from model {model_name!r}; "
+                     f"pass --family {sorted(set(FAMILY_OF_MODEL.values()))}")
+
+
+def hf_model_for(family: str, cfg):
+    """transformers model matching our model config ``cfg``."""
+    import transformers
+
+    if family == "gpt2":
+        return transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=cfg.vocab_size, n_positions=cfg.max_position,
+            n_embd=cfg.hidden_size, n_layer=cfg.num_layers,
+            n_head=cfg.num_heads, activation_function="gelu_new",
+            layer_norm_epsilon=cfg.layer_norm_eps))
+    if family == "bert":
+        return transformers.BertForMaskedLM(transformers.BertConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position,
+            type_vocab_size=cfg.type_vocab_size,
+            layer_norm_eps=cfg.layer_norm_eps, hidden_act="gelu"))
+    if family == "llama":
+        return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            num_key_value_heads=cfg.num_kv_heads,
+            rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
+            attention_bias=False, mlp_bias=False,
+            tie_word_embeddings=False))
+    raise SystemExit(f"unsupported family {family!r}")
+
+
+def export(model_name: str, checkpoint_dir: str, out_dir: str,
+           family=None, vocab_size=None, seq_len=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from distributeddeeplearning_tpu.models import model_spec
+    from distributeddeeplearning_tpu.train.checkpoint import Checkpointer
+
+    fam = _family(model_name, family)
+    spec = model_spec(model_name)
+    kw = {}
+    if vocab_size:
+        kw["vocab_size"] = vocab_size
+    if seq_len:
+        kw["seq_len"] = seq_len
+    model = spec.build(dtype=jnp.float32, **kw)
+    init = model.init({"params": jax.random.key(0)},
+                      jnp.zeros((1, 8), jnp.int32), train=False)
+    ckpt = Checkpointer(checkpoint_dir, every_steps=1)
+    try:
+        params = ckpt.restore_latest_params(init["params"])
+    finally:
+        ckpt.close()
+    if params is None:
+        raise SystemExit(f"no checkpoint in {checkpoint_dir!r}")
+
+    import numpy as np
+
+    np_params = jax.tree.map(lambda x: np.asarray(x, np.float32),
+                             jax.device_get(params))
+    sd = hf_convert.EXPORTERS[fam](np_params, model.cfg.num_layers)
+    hf = hf_model_for(fam, model.cfg)
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in sd.items()}, strict=False)
+    # strict=False only to tolerate non-parameter buffers (attn.bias masks,
+    # position_ids); any MISSING parameter is a real mapping hole.
+    missing = [m for m in missing if not m.endswith(
+        (".attn.bias", ".attn.masked_bias", ".position_ids"))]
+    if missing:
+        raise SystemExit(f"export mapping incomplete; HF model is missing "
+                         f"{missing[:8]}")
+    hf.save_pretrained(out_dir)
+    return {"family": fam, "out": os.path.abspath(out_dir),
+            "tensors": len(sd),
+            "param_count": sum(int(v.size) for v in sd.values())}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", required=True,
+                   help="framework model name (gpt2_small, bert_base, ...)")
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--family", default=None,
+                   choices=[None, "llama", "gpt2", "bert"])
+    p.add_argument("--vocab-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None)
+    args = p.parse_args(argv)
+    print(json.dumps(export(args.model, args.checkpoint_dir, args.out,
+                            args.family, args.vocab_size, args.seq_len)),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

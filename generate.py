@@ -33,6 +33,11 @@ def main(argv=None) -> int:
                    help="model context length (defaults to prompt+new)")
     p.add_argument("--vocab-size", type=int, default=None)
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel ways (Megatron-style kernel "
+                        "sharding over the model mesh axis) — serves a "
+                        "model too big for one chip; full-refeed and beam "
+                        "paths")
     p.add_argument("--num-beams", type=int, default=0,
                    help="beam-search decoding with this many beams "
                         "(deterministic; overrides temperature/top-k; "
@@ -54,9 +59,15 @@ def main(argv=None) -> int:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    import contextlib
+
+    import flax.linen as nn
     import jax
 
-    from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+    from distributeddeeplearning_tpu.parallel import sharding as shardlib
+    from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
     from distributeddeeplearning_tpu.models import model_spec
     from distributeddeeplearning_tpu.models.generate import (
         generate, generate_beam)
@@ -74,9 +85,15 @@ def main(argv=None) -> int:
     data_kw = dict(synthetic=True, seq_len=args.seq_len or total)
     if args.vocab_size:
         data_kw["vocab_size"] = args.vocab_size
+    if args.tp < 1:
+        raise SystemExit(f"--tp {args.tp}: need a positive ways count")
+    if args.tp > 1 and args.use_cache:
+        raise SystemExit("--tp shards the full-refeed/beam paths; drop "
+                         "--use-cache")
     cfg = TrainConfig(model=args.model, global_batch_size=len(prompts),
                       dtype="float32", checkpoint_dir=args.checkpoint_dir,
-                      backend=args.backend, data=DataConfig(**data_kw))
+                      backend=args.backend, data=DataConfig(**data_kw),
+                      parallel=ParallelConfig(model=args.tp))
 
     mesh, model, _, state, _, _, _ = loop.build(cfg, total_steps=1)
     ckpt = ckptlib.Checkpointer.create(cfg)
@@ -98,21 +115,32 @@ def main(argv=None) -> int:
             f"no checkpoint in {args.checkpoint_dir!r}; refusing to sample "
             "from randomly initialized weights")
 
-    if args.num_beams > 0:
-        if args.use_cache:
-            raise SystemExit("--num-beams uses the full-refeed path; drop "
-                             "--use-cache")
-        out = generate_beam(model, {"params": params}, prompts,
-                            max_new_tokens=args.max_new_tokens,
-                            num_beams=args.num_beams,
-                            length_penalty=args.length_penalty,
-                            eos_id=args.eos_id)
-    else:
-        out = generate(model, {"params": params}, prompts,
-                       max_new_tokens=args.max_new_tokens,
-                       temperature=args.temperature, top_k=args.top_k,
-                       rng=jax.random.key(args.seed),
-                       use_cache=args.use_cache)
+    # Under TP the model's logical-axis constraints must resolve against
+    # the mesh while the generation scan traces — same rules as training;
+    # the restored params already carry their NamedShardings (loop.build +
+    # the partial restore place them), so GSPMD propagates the kernel
+    # sharding through every decode forward.
+    ctx = contextlib.ExitStack()
+    if args.tp > 1:
+        ctx.enter_context(use_mesh(mesh))
+        ctx.enter_context(nn.logical_axis_rules(
+            list(shardlib.logical_rules(cfg.parallel))))
+    with ctx:
+        if args.num_beams > 0:
+            if args.use_cache:
+                raise SystemExit("--num-beams uses the full-refeed path; "
+                                 "drop --use-cache")
+            out = generate_beam(model, {"params": params}, prompts,
+                                max_new_tokens=args.max_new_tokens,
+                                num_beams=args.num_beams,
+                                length_penalty=args.length_penalty,
+                                eos_id=args.eos_id)
+        else:
+            out = generate(model, {"params": params}, prompts,
+                           max_new_tokens=args.max_new_tokens,
+                           temperature=args.temperature, top_k=args.top_k,
+                           rng=jax.random.key(args.seed),
+                           use_cache=args.use_cache)
     for row in jax.device_get(out).tolist():
         print(json.dumps({"tokens": row}), flush=True)
     return 0

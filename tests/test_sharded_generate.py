@@ -72,3 +72,26 @@ def test_tp_params_are_actually_sharded():
     k = params["layer0"]["mlp_in"]["kernel"]
     k = getattr(k, "value", k)  # unbox LogicallyPartitioned
     assert len(k.sharding.device_set) == 2, k.sharding
+
+
+@pytest.mark.usefixtures("devices8")
+def test_tp_generation_llama_gqa():
+    """TP over a GQA model: kv heads split across the model axis too."""
+    cfg = TrainConfig(
+        model="llama_tiny", global_batch_size=2, dtype="float32",
+        log_every=10**9, parallel=ParallelConfig(model=2),
+        data=DataConfig(synthetic=True, dataset="causal", seq_len=24,
+                        vocab_size=96))
+    mesh, model, _, state, _, _, _ = loop.build(cfg, 1)
+    host = jax.tree.map(jax.numpy.asarray, jax.device_get(state.params))
+    prompt = np.array([[3, 4, 5, 6]], np.int32)
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(use_mesh(mesh))
+    ctx.enter_context(nn.logical_axis_rules(
+        list(shardlib.logical_rules(cfg.parallel))))
+    with ctx:
+        out_tp = np.asarray(generate(model, {"params": state.params},
+                                     prompt, max_new_tokens=5))
+    out_ref = np.asarray(generate(model, {"params": host}, prompt,
+                                  max_new_tokens=5))
+    np.testing.assert_array_equal(out_tp, out_ref)

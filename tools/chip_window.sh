@@ -102,4 +102,19 @@ note matmul_micro
 timeout 600 python tools/profile_step.py --model resnet50 --batch-size 256 \
   --fused-block --top 25 > "$RES/profile_fused_block.json" 2>> "$RES/log.txt"
 note profile
+
+# 6. XLA-flag sweep on the headline config (quick protocol): any free wins
+# from scheduler/memory knobs the default compile doesn't enable. The jax
+# compilation cache keys on the flags, so cached default executables don't
+# mask these runs.
+for flags in \
+  "--xla_tpu_enable_latency_hiding_scheduler=true" \
+  "--xla_tpu_scoped_vmem_limit_kib=98304"; do
+  tag=$(echo "$flags" | tr -cd 'a-z_' | tail -c 24)
+  echo "[$(stamp)] xla flags: $flags" >> "$RES/log.txt"
+  XLA_FLAGS="$flags" \
+    timeout 420 python bench.py --steps 10 --attempts 1 --budget 400 \
+    --sweep none >> "$RES/xla_flag_sweep.json" 2>> "$RES/log.txt"
+  note "xla_$tag"
+done
 echo "[$(stamp)] window done" >> "$RES/log.txt"

@@ -36,8 +36,9 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel ways (Megatron-style kernel "
                         "sharding over the model mesh axis) — serves a "
-                        "model too big for one chip; full-refeed and beam "
-                        "paths")
+                        "model too big for one chip; composes with "
+                        "sampling, beam search, and --use-cache (the KV "
+                        "caches shard over heads)")
     p.add_argument("--num-beams", type=int, default=0,
                    help="beam-search decoding with this many beams "
                         "(deterministic; overrides temperature/top-k; "
@@ -87,9 +88,6 @@ def main(argv=None) -> int:
         data_kw["vocab_size"] = args.vocab_size
     if args.tp < 1:
         raise SystemExit(f"--tp {args.tp}: need a positive ways count")
-    if args.tp > 1 and args.use_cache:
-        raise SystemExit("--tp shards the full-refeed/beam paths; drop "
-                         "--use-cache")
     cfg = TrainConfig(model=args.model, global_batch_size=len(prompts),
                       dtype="float32", checkpoint_dir=args.checkpoint_dir,
                       backend=args.backend, data=DataConfig(**data_kw),

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Decode-throughput benchmark: emitted tokens/sec, KV-cache vs full-refeed.
+
+    python tools/bench_generate.py [--model gpt2_small] [--batch 8]
+        [--prompt-len 128] [--new-tokens 128] [--platform cpu]
+
+Random weights (throughput is weight-independent), greedy decode, one
+warmup generation (compile) then a timed one. Prints one JSON line per
+mode; the KV-cache line is the serving number (O(S) per token), the
+refeed line is the context the speedup is measured against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2_small")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--vocab-size", type=int, default=None)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--skip-refeed", action="store_true",
+                   help="cache-only (the refeed arm is O(S^2) and slow at "
+                        "long prompts)")
+    args = p.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearning_tpu.models import model_spec
+    from distributeddeeplearning_tpu.models.generate import generate
+
+    total = args.prompt_len + args.new_tokens
+    spec = model_spec(args.model)
+    kw = dict(dtype=jnp.bfloat16, seq_len=total)
+    if args.vocab_size:
+        kw["vocab_size"] = args.vocab_size
+    model = spec.build(**kw)
+    rng = np.random.default_rng(0)
+    vocab = model.cfg.vocab_size
+    prompt = jnp.asarray(
+        rng.integers(1, vocab, (args.batch, args.prompt_len)), jnp.int32)
+    variables = model.init({"params": jax.random.key(0)}, prompt[:, :8],
+                           train=False)
+
+    def timed(use_cache: bool) -> None:
+        t_c = time.perf_counter()
+        out = generate(model, variables, prompt,
+                       max_new_tokens=args.new_tokens, use_cache=use_cache)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t_c
+        t0 = time.perf_counter()
+        out = generate(model, variables, prompt,
+                       max_new_tokens=args.new_tokens, use_cache=use_cache)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"{args.model}_decode_tokens_per_sec",
+            "mode": "kv_cache" if use_cache else "full_refeed",
+            "value": round(args.batch * args.new_tokens / dt, 1),
+            "unit": "tokens/sec",
+            "batch": args.batch, "prompt_len": args.prompt_len,
+            "new_tokens": args.new_tokens,
+            "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
+        }), flush=True)
+
+    timed(True)
+    if not args.skip_refeed:
+        timed(False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,4 +117,9 @@ for flags in \
     --sweep none >> "$RES/xla_flag_sweep.json" 2>> "$RES/log.txt"
   note "xla_$tag"
 done
+# 7. Decode throughput (serving-side): GPT-2 KV-cache vs refeed.
+timeout 600 python tools/bench_generate.py --model gpt2_small --batch 8 \
+  --prompt-len 128 --new-tokens 128 > "$RES/decode_throughput.json" \
+  2>> "$RES/log.txt"
+note decode
 echo "[$(stamp)] window done" >> "$RES/log.txt"

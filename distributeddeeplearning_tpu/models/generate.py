@@ -27,6 +27,31 @@ import jax
 import jax.numpy as jnp
 
 
+def _require_decode(model, total: int) -> None:
+    """Shared use_cache preconditions for the sampling and beam paths.
+
+    The models validate only the PREFILL block length themselves; the
+    single-token emission steps afterwards would write past the cache
+    (clamped by dynamic_update_slice — silently degenerate), so the full
+    prompt+new budget is checked here, against ``max_position`` (GPT) or
+    ``decode_cache_len`` (Llama — size it to prompt+new, as the CLI does).
+    """
+    import inspect
+
+    if "decode" not in inspect.signature(model.__call__).parameters:
+        raise ValueError(
+            f"use_cache=True needs a model with a decode (KV-cache) mode — "
+            f"the GPT/Llama families; {type(model).__name__} has none. "
+            f"Use the default full-refeed path.")
+    mcfg = getattr(model, "cfg", None)
+    max_pos = (getattr(mcfg, "max_position", None)
+               or getattr(mcfg, "decode_cache_len", None))
+    if max_pos is not None and total > max_pos:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the model's "
+            f"max_position/decode_cache_len {max_pos}")
+
+
 def _make_sampler(temperature: float, top_k: int):
     def sample(logits, key):
         if temperature <= 0.0:
@@ -60,24 +85,7 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
     sample = _make_sampler(temperature, top_k)
 
     if use_cache:
-        import inspect
-
-        if "decode" not in inspect.signature(model.__call__).parameters:
-            raise ValueError(
-                f"use_cache=True needs a model with a decode (KV-cache) "
-                f"mode — the GPT/Llama families; {type(model).__name__} "
-                f"has none. Use the default full-refeed path.")
-        mcfg = getattr(model, "cfg", None)
-        max_pos = (getattr(mcfg, "max_position", None)
-                   or getattr(mcfg, "decode_cache_len", None))
-        if max_pos is not None and total > max_pos:
-            # The models check the PREFILL block length themselves, but the
-            # single-token emission steps afterwards would write past the
-            # cache (clamped, silently degenerate) — this guard covers the
-            # full prompt+new budget up front.
-            raise ValueError(
-                f"prompt ({p}) + max_new_tokens ({total - p}) = {total} "
-                f"exceeds the model's max_position {max_pos}")
+        _require_decode(model, total)
         return _generate_cached(model, variables, prompt_ids, total=total,
                                 pad_id=pad_id, sample=sample, rng=rng)
 
@@ -104,7 +112,8 @@ def generate(model, variables, prompt_ids, *, max_new_tokens: int,
 
 def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
                   num_beams: int = 4, length_penalty: float = 1.0,
-                  eos_id: Optional[int] = None, pad_id: int = 0):
+                  eos_id: Optional[int] = None, pad_id: int = 0,
+                  use_cache: bool = False):
     """Beam-search decoding: (B, P) -> (B, P + max_new_tokens) int32.
 
     Fixed-shape throughout (one compile): beams live as a flattened
@@ -116,6 +125,13 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
     with ``pad_id`` at unchanged score. Final ranking divides scores by
     (emitted length)**length_penalty (>1 favors longer hypotheses;
     identical lengths make it a no-op). Deterministic: no RNG anywhere.
+
+    ``use_cache=True`` (GPT/Llama decode mode) keeps per-beam KV caches:
+    one batched prefill primes a (B,)-cache that is expanded to (B*K,);
+    each step reorders the caches by surviving parent beam
+    (take_along_axis over the batch dim) and runs one single-token
+    forward — O(S) per token instead of the full-refeed O(S^2). Emitted
+    tokens are identical to the refeed beam (tests pin this).
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, p = prompt_ids.shape
@@ -129,15 +145,10 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
     ids0 = ids0.at[:, :, :p].set(prompt_ids[:, None, :])
     scores0 = jnp.full((b, k), neg).at[:, 0].set(0.0)
     finished0 = jnp.zeros((b, k), bool)
-    mask0 = jnp.broadcast_to(
-        (jnp.arange(total)[None, :] < p).astype(jnp.int32), (b * k, total))
 
-    def step(carry, _):
-        ids, scores, finished, mask, pos = carry
-        logits = model.apply(variables, ids.reshape(b * k, total),
-                             attention_mask=mask, train=False)
-        next_logits = jax.lax.dynamic_slice_in_dim(
-            logits, pos - 1, 1, axis=1)[:, 0]              # (B*K, V)
+    def select(next_logits, ids, scores, finished, pos):
+        """Shared candidate ranking: extend every live beam by its top
+        continuations, keep K per batch row, reorder survivors."""
         logp = jax.nn.log_softmax(next_logits).reshape(b, k, -1)
         v = logp.shape[-1]
         if eos_id is not None:
@@ -153,13 +164,32 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
         if eos_id is not None:
             was_done = jnp.take_along_axis(finished, beam_idx, axis=1)
             finished = was_done | (tok == eos_id)
-        mask = mask.reshape(b, k, total).at[:, :, pos].set(1)
-        return (ids, top_scores, finished, mask.reshape(b * k, total),
-                pos + 1), None
+        return ids, top_scores, finished, beam_idx, tok
 
-    (ids, scores, finished, _, _), _ = jax.lax.scan(
-        step, (ids0, scores0, finished0, mask0, jnp.int32(p)), None,
-        length=max_new_tokens)
+    if use_cache:
+        ids, scores, finished = _beam_cached(
+            model, variables, prompt_ids, ids0, scores0, finished0,
+            select, total=total, num_beams=k)
+    else:
+        mask0 = jnp.broadcast_to(
+            (jnp.arange(total)[None, :] < p).astype(jnp.int32),
+            (b * k, total))
+
+        def step(carry, _):
+            ids, scores, finished, mask, pos = carry
+            logits = model.apply(variables, ids.reshape(b * k, total),
+                                 attention_mask=mask, train=False)
+            next_logits = jax.lax.dynamic_slice_in_dim(
+                logits, pos - 1, 1, axis=1)[:, 0]          # (B*K, V)
+            ids, scores, finished, _, _ = select(
+                next_logits, ids, scores, finished, pos)
+            mask = mask.reshape(b, k, total).at[:, :, pos].set(1)
+            return (ids, scores, finished, mask.reshape(b * k, total),
+                    pos + 1), None
+
+        (ids, scores, finished, _, _), _ = jax.lax.scan(
+            step, (ids0, scores0, finished0, mask0, jnp.int32(p)), None,
+            length=max_new_tokens)
 
     if eos_id is not None:
         # Emitted length = tokens up to and including eos (or the full
@@ -175,6 +205,54 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
         jnp.float32) ** jnp.float32(length_penalty)
     best = jnp.argmax(norm, axis=1)
     return jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
+
+
+def _beam_cached(model, variables, prompt_ids, ids0, scores0, finished0,
+                 select, *, total: int, num_beams: int):
+    """KV-cache beam search: prefill once at batch B, expand the cache to
+    B*K beam rows, then per step reorder caches by surviving parent beam
+    and run one single-token forward. The last iteration's forward feeds
+    no selection (its logits are discarded) — one redundant token-forward
+    per generation, kept for scan-shape simplicity."""
+    _require_decode(model, total)
+    b, p = prompt_ids.shape
+    k = num_beams
+
+    fresh = {key: v for key, v in variables.items() if key != "cache"}
+    logits0, mut = model.apply(fresh, prompt_ids, train=False,
+                               decode=True, mutable=["cache"])
+
+    def expand(x):
+        # (B, ...) cache rows -> (B*K, ...): row b*K+j is beam j of batch b.
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b:
+            return jnp.repeat(x, k, axis=0)
+        return x  # per-layer scalar write indices stay shared
+
+    cache0 = jax.tree_util.tree_map(expand, mut["cache"])
+    next0 = jnp.repeat(logits0[:, -1], k, axis=0)           # (B*K, V)
+    batch_base = jnp.arange(b)[:, None] * k
+
+    def step(carry, t):
+        ids, scores, finished, cache, next_logits = carry
+        ids, scores, finished, beam_idx, tok = select(
+            next_logits, ids, scores, finished, t)
+        flat = (batch_base + beam_idx).reshape(-1)
+
+        def reorder(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b * k:
+                return jnp.take(x, flat, axis=0)
+            return x
+
+        cache = jax.tree_util.tree_map(reorder, cache)
+        logits, mut = model.apply(
+            {**fresh, "cache": cache}, tok.reshape(b * k, 1),
+            train=False, decode=True, mutable=["cache"])
+        return (ids, scores, finished, mut["cache"], logits[:, -1]), None
+
+    (ids, scores, finished, _, _), _ = jax.lax.scan(
+        step, (ids0, scores0, finished0, cache0, next0),
+        jnp.arange(p, total))
+    return ids, scores, finished
 
 
 def _generate_cached(model, variables, prompt_ids, *, total: int,

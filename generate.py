@@ -42,7 +42,7 @@ def main(argv=None) -> int:
     p.add_argument("--num-beams", type=int, default=0,
                    help="beam-search decoding with this many beams "
                         "(deterministic; overrides temperature/top-k; "
-                        "full-refeed path)")
+                        "composes with --use-cache for O(S)/token beams)")
     p.add_argument("--length-penalty", type=float, default=1.0,
                    help="beam scores divide by length**alpha (>1 favors "
                         "longer hypotheses); only with --num-beams")
@@ -125,14 +125,12 @@ def main(argv=None) -> int:
             list(shardlib.logical_rules(cfg.parallel))))
     with ctx:
         if args.num_beams > 0:
-            if args.use_cache:
-                raise SystemExit("--num-beams uses the full-refeed path; "
-                                 "drop --use-cache")
             out = generate_beam(model, {"params": params}, prompts,
                                 max_new_tokens=args.max_new_tokens,
                                 num_beams=args.num_beams,
                                 length_penalty=args.length_penalty,
-                                eos_id=args.eos_id)
+                                eos_id=args.eos_id,
+                                use_cache=args.use_cache)
         else:
             out = generate(model, {"params": params}, prompts,
                            max_new_tokens=args.max_new_tokens,

@@ -240,3 +240,50 @@ def test_beam_eos_freezes_and_pads():
     assert (gen == eos).any(), "eos was never emitted; test setup broken"
     after = gen[np.argmax(gen == eos) + 1:]
     assert (after == 0).all()
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_cached_beam_matches_refeed_beam(family):
+    """KV-cache beam search emits exactly what the full-refeed beam emits
+    (per-beam cache reorder is the only new machinery)."""
+    from distributeddeeplearning_tpu.models.generate import generate_beam
+
+    model, variables = _tiny(family)
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 97, (2, 4)).astype(np.int32)
+    ref = np.asarray(generate_beam(model, variables, prompt,
+                                   max_new_tokens=5, num_beams=3))
+    cached = np.asarray(generate_beam(model, variables, prompt,
+                                      max_new_tokens=5, num_beams=3,
+                                      use_cache=True))
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_cached_beam_eos_matches_refeed():
+    from distributeddeeplearning_tpu.models.generate import generate_beam
+
+    model, variables = _tiny("gpt")
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 97, (1, 4)).astype(np.int32)
+    free = np.asarray(generate_beam(model, variables, prompt,
+                                    max_new_tokens=6, num_beams=3))
+    eos = int(free[0, 4])
+    kw = dict(max_new_tokens=6, num_beams=3, eos_id=eos, pad_id=0,
+              length_penalty=0.0)
+    ref = np.asarray(generate_beam(model, variables, prompt, **kw))
+    cached = np.asarray(generate_beam(model, variables, prompt,
+                                      use_cache=True, **kw))
+    np.testing.assert_array_equal(cached, ref)
+
+
+def test_cached_beam_overflow_guard():
+    """Cached beam search must raise (not silently clamp) when
+    prompt+max_new_tokens exceeds the cache length — parity with the
+    sampling path's guard."""
+    from distributeddeeplearning_tpu.models.generate import generate_beam
+
+    model, variables = _tiny("gpt")  # max_position defaults to 128
+    prompt = np.ones((1, 4), np.int32)
+    with pytest.raises(ValueError, match="max_position|decode_cache_len"):
+        generate_beam(model, variables, prompt, max_new_tokens=1000,
+                      num_beams=2, use_cache=True)

@@ -48,8 +48,10 @@ def _require_decode(model, total: int) -> None:
                or getattr(mcfg, "decode_cache_len", None))
     if max_pos is not None and total > max_pos:
         raise ValueError(
-            f"prompt + max_new_tokens = {total} exceeds the model's "
-            f"max_position/decode_cache_len {max_pos}")
+            f"this decode needs cache/position capacity {total} (prompt + "
+            f"max_new_tokens, plus draft_len slack on the speculative "
+            f"path) but the model's max_position/decode_cache_len is "
+            f"{max_pos}")
 
 
 def _make_sampler(temperature: float, top_k: int):
@@ -253,6 +255,134 @@ def _beam_cached(model, variables, prompt_ids, ids0, scores0, finished0,
         step, (ids0, scores0, finished0, cache0, next0),
         jnp.arange(p, total))
     return ids, scores, finished
+
+
+def _rewind_cache(cache, to_index):
+    """Set every per-layer write index (``cache_index``, and GPT's shared
+    ``position`` counter) to ``to_index``. Stale K/V entries past the index
+    are dead: attention masks slots >= index and the next write overwrites
+    them — so a rewind is just the scalars."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(cache)
+    for path in flat:
+        if path[-1] in ("cache_index", "position"):
+            flat[path] = jnp.asarray(to_index, jnp.int32)
+    return traverse_util.unflatten_dict(flat)
+
+
+def generate_speculative(target_model, target_variables, draft_model,
+                         draft_variables, prompt_ids, *,
+                         max_new_tokens: int, draft_len: int = 4,
+                         pad_id: int = 0):
+    """Speculative greedy decoding: draft proposes, target verifies.
+
+    Each round the draft model emits ``draft_len`` greedy tokens with
+    cheap single-token forwards; the target model scores them all in ONE
+    block forward and accepts the longest prefix that matches its own
+    greedy choices, emitting a correction token at the first mismatch.
+    Every round advances at least one token, and the output is EXACTLY
+    the target model's greedy continuation (the acceptance rule never
+    admits a token the target would not have picked) — tests pin this
+    token-for-token. Rounds where all ``draft_len`` tokens are accepted
+    emit them without a bonus token, which keeps both caches' invariants
+    one-scalar simple (see :func:`_rewind_cache`).
+
+    Batch 1 only: acceptance lengths are data-dependent per row, and the
+    cache write indices are shared scalars per layer. Greedy only (the
+    standard rejection-sampling extension needs per-token RNG plumbing).
+    Both models must share a vocabulary.
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    b, p = prompt_ids.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is batch-1 only (got batch {b}): "
+            f"per-row acceptance lengths cannot share the per-layer "
+            f"scalar cache indices")
+    if p < 2:
+        raise ValueError("speculative decoding needs a prompt of >= 2 "
+                         "tokens (the prefill feeds all but the last)")
+    if draft_len < 1:
+        raise ValueError(f"draft_len={draft_len}: need >= 1 proposal per "
+                         f"verify round")
+    total = p + max_new_tokens
+    k = draft_len
+    # The last verify round can write up to k cache slots past `total`
+    # before the rewind; both caches must hold them.
+    _require_decode(target_model, total + k)
+    _require_decode(draft_model, total + k)
+
+    t_fresh = {key: v for key, v in target_variables.items()
+               if key != "cache"}
+    d_fresh = {key: v for key, v in draft_variables.items()
+               if key != "cache"}
+
+    # Prefill both models on all but the last prompt token; the last token
+    # becomes the first round's block head, keeping the invariant "caches
+    # hold positions [0, pos-1); `last` is decided but unfed".
+    t_logits, t_mut = target_model.apply(
+        t_fresh, prompt_ids[:, :-1], train=False, decode=True,
+        mutable=["cache"])
+    _, d_mut = draft_model.apply(
+        d_fresh, prompt_ids[:, :-1], train=False, decode=True,
+        mutable=["cache"])
+    # k slack columns so the block write near the end never triggers
+    # dynamic_update_slice's start-clamping (which would shift the write);
+    # trimmed before returning.
+    ids0 = jnp.full((1, total + k), pad_id,
+                    jnp.int32).at[:, :p].set(prompt_ids)
+    if max_new_tokens <= 0:
+        return ids0[:, :total]
+
+    def cond(carry):
+        return carry[1] < total
+
+    def body(carry):
+        ids, pos, last, t_cache, d_cache = carry
+        # --- draft k tokens: k cheap single-token forwards ---------------
+        d_toks = []
+        feed = last
+        dc = d_cache
+        for _ in range(k):
+            dl, dm = draft_model.apply(
+                {**d_fresh, "cache": dc}, feed[:, None], train=False,
+                decode=True, mutable=["cache"])
+            feed = jnp.argmax(dl[:, -1], axis=-1).astype(jnp.int32)
+            d_toks.append(feed)
+            dc = dm["cache"]
+        d_block = jnp.stack(d_toks, axis=1)                # (1, K)
+        # --- target verifies the whole block in one forward --------------
+        block = jnp.concatenate([last[:, None], d_block], axis=1)  # (1,K+1)
+        tl, tm = target_model.apply(
+            {**t_fresh, "cache": t_cache}, block, train=False,
+            decode=True, mutable=["cache"])
+        greedy = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # (1, K+1)
+        # greedy[:, j] is the target's choice for position pos+j; accept
+        # the longest draft prefix matching it.
+        match = d_block == greedy[:, :k]                    # (1, K)
+        m = jnp.argmin(match, axis=1)                       # first mismatch
+        m = jnp.where(match.all(axis=1), k, m)[0]
+        # Emit d_0..d_{m-1} then (if m < K) the correction greedy[:, m].
+        emit = jnp.where(jnp.arange(k)[None, :] < m, d_block,
+                         jnp.where(jnp.arange(k)[None, :] == m,
+                                   greedy[:, :k], pad_id))
+        n_emit = jnp.minimum(jnp.where(m == k, k, m + 1), total - pos)
+        keep = jnp.arange(k)[None, :] < n_emit
+        cur = jax.lax.dynamic_slice(ids, (0, pos), (1, k))
+        ids = jax.lax.dynamic_update_slice(
+            ids, jnp.where(keep, emit, cur), (0, pos))
+        new_pos = pos + n_emit
+        last = jax.lax.dynamic_slice(ids, (0, new_pos - 1), (1, 1))[:, 0]
+        return (ids, new_pos, last,
+                _rewind_cache(tm["cache"], new_pos - 1),
+                _rewind_cache(dc, new_pos - 1))
+
+    last0 = prompt_ids[:, -1]
+    ids, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (ids0, jnp.int32(p), last0, t_mut["cache"],
+                     d_mut["cache"]))
+    return ids[:, :total]
 
 
 def _generate_cached(model, variables, prompt_ids, *, total: int,

@@ -53,6 +53,15 @@ def main(argv=None) -> int:
                    help="KV-cache incremental decoding (GPT and Llama "
                         "families): O(S) per token instead of full-refeed "
                         "O(S^2); output is identical at the same seed")
+    p.add_argument("--draft-model", default=None,
+                   help="speculative decoding: draft-model name (same "
+                        "vocabulary); emits the EXACT target greedy "
+                        "continuation with fewer target forwards. "
+                        "Batch-1, greedy only")
+    p.add_argument("--draft-checkpoint-dir", default=None,
+                   help="checkpoint for --draft-model")
+    p.add_argument("--draft-len", type=int, default=4,
+                   help="draft tokens proposed per verify round")
     args = p.parse_args(argv)
 
     import os
@@ -83,7 +92,10 @@ def main(argv=None) -> int:
     spec = model_spec(args.model)
     if spec.objective != "causal":
         raise SystemExit(f"{args.model!r} is not a causal LM")
-    data_kw = dict(synthetic=True, seq_len=args.seq_len or total)
+    # The speculative path writes up to draft_len cache slots past `total`
+    # before each rewind, so both models get that much position/cache slack.
+    slack = args.draft_len if args.draft_model else 0
+    data_kw = dict(synthetic=True, seq_len=(args.seq_len or total) + slack)
     if args.vocab_size:
         data_kw["vocab_size"] = args.vocab_size
     if args.tp < 1:
@@ -101,13 +113,13 @@ def main(argv=None) -> int:
         params = ckpt.restore_latest_params(state.params)
     finally:
         ckpt.close()
-    if args.use_cache and hasattr(model, "cfg") and hasattr(
-            model.cfg, "decode_cache_len"):
+    if ((args.use_cache or args.draft_model) and hasattr(model, "cfg")
+            and hasattr(model.cfg, "decode_cache_len")):
         # Right-size the Llama KV cache to this request: a fixed default
         # buffer would make every decode step attend over unused slots.
         import dataclasses
         model = model.clone(cfg=dataclasses.replace(
-            model.cfg, decode_cache_len=total))
+            model.cfg, decode_cache_len=total + slack))
     if params is None:
         raise SystemExit(
             f"no checkpoint in {args.checkpoint_dir!r}; refusing to sample "
@@ -123,8 +135,48 @@ def main(argv=None) -> int:
         ctx.enter_context(use_mesh(mesh))
         ctx.enter_context(nn.logical_axis_rules(
             list(shardlib.logical_rules(cfg.parallel))))
+    draft = None
+    if args.draft_model:
+        if args.num_beams or args.temperature > 0 or args.tp > 1:
+            raise SystemExit("--draft-model (speculative) is greedy, "
+                             "single-stream, untensored; drop "
+                             "--num-beams/--temperature/--tp")
+        if args.use_cache:
+            raise SystemExit("--draft-model decodes through KV caches "
+                             "already; drop --use-cache")
+        if args.draft_len < 1:
+            raise SystemExit(f"--draft-len {args.draft_len}: need >= 1")
+        if not args.draft_checkpoint_dir:
+            raise SystemExit("--draft-model needs --draft-checkpoint-dir")
+        dcfg = cfg.replace(model=args.draft_model,
+                           checkpoint_dir=args.draft_checkpoint_dir)
+        _, draft_model, _, dstate, _, _, _ = loop.build(dcfg, total_steps=1)
+        if hasattr(draft_model, "cfg") and hasattr(draft_model.cfg,
+                                                   "decode_cache_len"):
+            import dataclasses
+            draft_model = draft_model.clone(cfg=dataclasses.replace(
+                draft_model.cfg, decode_cache_len=total + slack))
+        dckpt = ckptlib.Checkpointer.create(dcfg)
+        try:
+            draft_params = dckpt.restore_latest_params(dstate.params)
+        finally:
+            dckpt.close()
+        if draft_params is None:
+            raise SystemExit(
+                f"no draft checkpoint in {args.draft_checkpoint_dir!r}")
+        draft = (draft_model, draft_params)
+
     with ctx:
-        if args.num_beams > 0:
+        if draft is not None:
+            from distributeddeeplearning_tpu.models.generate import (
+                generate_speculative)
+            draft_model, draft_params = draft
+            out = generate_speculative(
+                model, {"params": params}, draft_model,
+                {"params": draft_params}, prompts,
+                max_new_tokens=args.max_new_tokens,
+                draft_len=args.draft_len)
+        elif args.num_beams > 0:
             out = generate_beam(model, {"params": params}, prompts,
                                 max_new_tokens=args.max_new_tokens,
                                 num_beams=args.num_beams,

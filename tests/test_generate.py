@@ -287,3 +287,67 @@ def test_cached_beam_overflow_guard():
     with pytest.raises(ValueError, match="max_position|decode_cache_len"):
         generate_beam(model, variables, prompt, max_new_tokens=1000,
                       num_beams=2, use_cache=True)
+
+
+def test_speculative_matches_target_greedy():
+    """Speculative decoding's whole contract: EXACTLY the target model's
+    greedy continuation, regardless of what the draft proposes."""
+    from distributeddeeplearning_tpu.models.generate import (
+        generate_speculative)
+
+    target = gpt.tiny_gpt(vocab_size=97, dropout_rate=0.0)
+    draft = gpt.GptLM(gpt.GptConfig(
+        vocab_size=97, hidden_size=32, num_layers=1, num_heads=2,
+        max_position=128, dropout_rate=0.0), dtype=jnp.float32)
+    ids = jnp.ones((1, 4), jnp.int32)
+    tv = target.init({"params": jax.random.key(0)}, ids, train=False)
+    dv = draft.init({"params": jax.random.key(1)}, ids, train=False)
+
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 97, (1, 5)).astype(np.int32)
+    ref = np.asarray(generate(target, tv, prompt, max_new_tokens=9))
+    for draft_len in (1, 3, 4):
+        out = np.asarray(generate_speculative(
+            target, tv, draft, dv, prompt, max_new_tokens=9,
+            draft_len=draft_len))
+        np.testing.assert_array_equal(out, ref, err_msg=f"K={draft_len}")
+
+
+def test_speculative_self_draft_accepts_everything():
+    """Draft == target: every proposal accepted; output still exact."""
+    from distributeddeeplearning_tpu.models.generate import (
+        generate_speculative)
+
+    model, variables = _tiny("gpt")
+    prompt = np.asarray([[3, 5, 7, 9]], np.int32)
+    ref = np.asarray(generate(model, variables, prompt, max_new_tokens=8))
+    out = np.asarray(generate_speculative(
+        model, variables, model, variables, prompt, max_new_tokens=8,
+        draft_len=4))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_llama_and_guards():
+    from distributeddeeplearning_tpu.models.generate import (
+        generate_speculative)
+
+    target = llama.tiny_llama(vocab_size=97)
+    draft = llama.LlamaLM(llama.LlamaConfig(
+        vocab_size=97, hidden_size=32, num_layers=1, num_heads=2,
+        num_kv_heads=2, intermediate_size=64, decode_cache_len=64),
+        dtype=jnp.float32)
+    ids = jnp.ones((1, 4), jnp.int32)
+    tv = target.init({"params": jax.random.key(2)}, ids, train=False)
+    dv = draft.init({"params": jax.random.key(3)}, ids, train=False)
+    prompt = np.asarray([[4, 8, 15, 16]], np.int32)
+    ref = np.asarray(generate(target, tv, prompt, max_new_tokens=7))
+    out = np.asarray(generate_speculative(
+        target, tv, draft, dv, prompt, max_new_tokens=7, draft_len=3))
+    np.testing.assert_array_equal(out, ref)
+
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative(target, tv, draft, dv,
+                             np.ones((2, 4), np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match=">= 2"):
+        generate_speculative(target, tv, draft, dv,
+                             np.ones((1, 1), np.int32), max_new_tokens=2)

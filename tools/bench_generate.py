@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     p.add_argument("--skip-refeed", action="store_true",
                    help="cache-only (the refeed arm is O(S^2) and slow at "
                         "long prompts)")
+    p.add_argument("--speculative", action="store_true",
+                   help="add a self-draft speculative arm (batch 1): the "
+                        "all-accepted upper bound on spec-decode speedup")
+    p.add_argument("--draft-len", type=int, default=4)
     args = p.parse_args(argv)
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
@@ -81,6 +85,31 @@ def main(argv=None) -> int:
     timed(True)
     if not args.skip_refeed:
         timed(False)
+    if args.speculative:
+        from distributeddeeplearning_tpu.models.generate import (
+            generate_speculative)
+
+        prompt1 = prompt[:1]
+
+        def spec():
+            return generate_speculative(
+                model, variables, model, variables, prompt1,
+                max_new_tokens=args.new_tokens, draft_len=args.draft_len)
+
+        t_c = time.perf_counter()
+        jax.block_until_ready(spec())
+        compile_s = time.perf_counter() - t_c
+        t0 = time.perf_counter()
+        jax.block_until_ready(spec())
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"{args.model}_decode_tokens_per_sec",
+            "mode": f"speculative_selfdraft_k{args.draft_len}",
+            "value": round(args.new_tokens / dt, 1),
+            "unit": "tokens/sec", "batch": 1,
+            "prompt_len": args.prompt_len, "new_tokens": args.new_tokens,
+            "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
+        }), flush=True)
     return 0
 
 

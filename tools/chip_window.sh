@@ -122,4 +122,9 @@ timeout 600 python tools/bench_generate.py --model gpt2_small --batch 8 \
   --prompt-len 128 --new-tokens 128 > "$RES/decode_throughput.json" \
   2>> "$RES/log.txt"
 note decode
+
+# 8. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
+timeout 600 python tools/validate_flash_tpu.py \
+  > "$RES/flash_validate.json" 2>> "$RES/log.txt"
+note flash
 echo "[$(stamp)] window done" >> "$RES/log.txt"

@@ -93,6 +93,11 @@ class OptimizerConfig:
     schedule: str = "warmup_cosine"  # warmup_cosine | constant | linear
     label_smoothing: float = 0.1
     grad_clip_norm: Optional[float] = None
+    # Exponential moving average of params (0 = off). When on, every
+    # update folds new params in at (1 - decay) and ALL held-out evals
+    # (periodic, final, --eval-only) score the EMA weights — the classic
+    # ImageNet/BERT eval-smoothing recipe.
+    ema_decay: float = 0.0
     # LARS (config 5, BASELINE.json:11):
     trust_coefficient: float = 0.001
     # AdamW (BERT):

@@ -152,9 +152,16 @@ class Checkpointer:
         if batch_stats is not None:
             batch_stats = self._restore_subtree(
                 restored["batch_stats"], batch_stats, "batch_stats")
+        # EMA shadow params follow the CHECKPOINT, not the flag: if the
+        # training run kept an EMA, eval-only scores it (the documented
+        # contract) whether or not --ema-decay was repeated; if it did not,
+        # a fresh-init EMA from the flag must not shadow the trained params.
+        ema = restored.get("ema_params")
+        ema = (self._restore_subtree(ema, state_like.params, "ema_params")
+               if ema is not None else None)
         return state_like.replace(
             step=jnp.asarray(restored["step"], jnp.int32),
-            params=params, batch_stats=batch_stats)
+            params=params, batch_stats=batch_stats, ema_params=ema)
 
     def verify_or_record_stream_meta(self, meta: dict) -> None:
         """Pin environment-dependent data-stream facts (e.g. the resolved

@@ -192,7 +192,9 @@ def build(config: TrainConfig, total_steps: int):
             params = variables["params"]
             return TrainState.create(
                 params=params, opt_state=tx.init(params),
-                batch_stats=variables.get("batch_stats"))
+                batch_stats=variables.get("batch_stats"),
+                ema_params=(params if config.optimizer.ema_decay > 0
+                            else None))
 
         replicated = shardlib.replicated(mesh)
         state = jax.jit(init_fn, out_shardings=replicated)(rng)
@@ -557,6 +559,10 @@ class _EvaluatorBase:
             objective=self.objective), 0
 
     def __call__(self, state) -> float:
+        if state.ema_params is not None:
+            # EMA evaluation: score the shadow weights (the reason the
+            # EMA exists); training params continue unaffected.
+            state = state.replace(params=state.ema_params)
         source, offset = self._source_and_offset()
         outs = (jax.device_get(self.eval_step(state, source.batch(offset + j)))
                 for j in range(self.num_batches))

@@ -68,6 +68,11 @@ def make_schedule(cfg: OptimizerConfig, global_batch: int,
 def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
                    steps_per_epoch: Optional[int] = None
                    ) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    if not 0.0 <= cfg.ema_decay < 1.0:
+        raise ValueError(
+            f"ema_decay={cfg.ema_decay}: need 0 <= decay < 1 "
+            f"(1.0 would freeze the shadow params at init "
+            f"forever; evals would score random weights)")
     sched = make_schedule(cfg, global_batch, total_steps, steps_per_epoch)
     if cfg.name == "sgd":
         tx = optax.chain(

@@ -18,9 +18,13 @@ class TrainState:
     params: Any                       # model parameters (f32)
     opt_state: Any                    # optax state
     batch_stats: Any = None           # BN running stats (CNNs) or None
+    ema_params: Any = None            # EMA shadow params (optimizer.ema_decay
+                                      # > 0); evals read these when present
 
     @classmethod
     def create(cls, *, params: Any, opt_state: Any,
-               batch_stats: Optional[Any] = None) -> "TrainState":
+               batch_stats: Optional[Any] = None,
+               ema_params: Optional[Any] = None) -> "TrainState":
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   opt_state=opt_state, batch_stats=batch_stats)
+                   opt_state=opt_state, batch_stats=batch_stats,
+                   ema_params=ema_params)

@@ -41,6 +41,16 @@ from distributeddeeplearning_tpu.train.state import TrainState
 DATA_AXES = ("data", "fsdp")
 
 
+def _ema_update(ema, new_params, decay: float):
+    """Shadow-param EMA: e <- d*e + (1-d)*p. None stays None (off)."""
+    if ema is None:
+        return None
+    d = jnp.float32(decay)
+    return jax.tree_util.tree_map(
+        lambda e, p: (d * e + (1.0 - d) * p).astype(p.dtype),
+        ema, new_params)
+
+
 # ---------------------------------------------------------------------------
 # Forward/loss closures per input kind
 # ---------------------------------------------------------------------------
@@ -220,8 +230,11 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = _ema_update(state.ema_params, new_params,
+                              config.optimizer.ema_decay)
         new_state = TrainState(step=state.step + 1, params=new_params,
-                               opt_state=new_opt, batch_stats=new_bn)
+                               opt_state=new_opt, batch_stats=new_bn,
+                               ema_params=new_ema)
         return new_state, metrics
 
     batch_spec = P(DATA_AXES)
@@ -335,7 +348,9 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
         opt_state = tx.init(params)
         return TrainState.create(
             params=params, opt_state=opt_state,
-            batch_stats=variables.get("batch_stats"))
+            batch_stats=variables.get("batch_stats"),
+            ema_params=(params if config.optimizer.ema_decay > 0
+                        else None))
 
     with use_mesh(mesh):  # model may embed mesh-dependent shard_maps (ring)
         abstract = jax.eval_shape(init_fn, rng)
@@ -369,8 +384,11 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
                 config.grad_accum_steps)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = _ema_update(state.ema_params, new_params,
+                              config.optimizer.ema_decay)
         new_state = TrainState(step=state.step + 1, params=new_params,
-                               opt_state=new_opt, batch_stats=new_bn)
+                               opt_state=new_opt, batch_stats=new_bn,
+                               ema_params=new_ema)
         return new_state, metrics
 
     batch_shardings = functools.partial(_batch_leaf_shardings, mesh, batch_shd)

@@ -68,6 +68,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="conv-epilogue fusion: bottleneck 1x1 convs as "
                         "Pallas matmul+BN (ops/fused_linear_bn.py; "
                         "resnet50/101/152)")
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="exponential-moving-average of params (e.g. "
+                        "0.9999); evals score the EMA weights")
     p.add_argument("--sync-bn", action="store_true", default=None,
                    help="cross-replica BatchNorm statistics (psum over the "
                         "data axis, torch SyncBatchNorm semantics; pure-DP "
@@ -195,6 +198,9 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(fused_block=True)
     if args.sync_bn:
         cfg = cfg.replace(sync_bn=True)
+    if args.ema_decay is not None:
+        cfg = cfg.replace(optimizer=dataclasses.replace(
+            cfg.optimizer, ema_decay=args.ema_decay))
     if args.pp_microbatches is not None:
         cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
 

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Environment diagnosis: one command that answers "why doesn't it run?".
+
+    python tools/doctor.py [--probe-timeout 45]
+
+Checks, each printed as one JSON line (never raises, never hangs):
+accelerator reachability (subprocess probe with a hard timeout — a dead
+tunnel hangs forever otherwise), virtual CPU mesh, library versions,
+native toolchain + in-tree loader build, data-loader auto-resolution,
+XLA compile-cache state, and the last recorded benchmark measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def emit(check: str, **kw) -> None:
+    print(json.dumps({"check": check, **kw}), flush=True)
+
+
+def check_accelerator(timeout: int) -> None:
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print(d[0].platform, len(d))"],
+            capture_output=True, text=True, timeout=timeout)
+        out = r.stdout.strip().splitlines()
+        if r.returncode == 0 and out:
+            platform, n = out[-1].split()
+            emit("accelerator", ok=True, platform=platform, devices=int(n),
+                 init_s=round(time.time() - t0, 1))
+        else:
+            emit("accelerator", ok=False,
+                 error=(r.stderr.strip().splitlines() or ["no output"])[-1])
+    except subprocess.TimeoutExpired:
+        emit("accelerator", ok=False,
+             error=f"backend init exceeded {timeout}s — the TPU tunnel is "
+                   f"down or hanging; CPU paths still work (JAX_PLATFORMS="
+                   f"cpu)")
+
+
+def check_cpu_mesh() -> None:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+        n = int(r.stdout.strip().splitlines()[-1])
+        emit("virtual_cpu_mesh", ok=n == 8, devices=n)
+    except Exception as e:
+        emit("virtual_cpu_mesh", ok=False, error=str(e)[:200])
+
+
+def check_versions() -> None:
+    import importlib.metadata as md
+    vers = {}
+    for pkg in ("jax", "jaxlib", "libtpu", "flax", "optax",
+                "orbax-checkpoint", "grain", "tensorflow", "torch",
+                "transformers"):
+        try:
+            vers[pkg] = md.version(pkg)
+        except md.PackageNotFoundError:
+            vers[pkg] = None
+    emit("versions", ok=all(vers[p] for p in ("jax", "flax", "optax")),
+         **{k.replace("-", "_"): v for k, v in vers.items()})
+
+
+def check_native() -> None:
+    tools = {t: bool(shutil.which(t)) for t in ("g++", "make", "cmake")}
+    lib = os.path.join(REPO, "distributeddeeplearning_tpu", "data",
+                       "_native", "libddl_loader.so")
+    built = os.path.exists(lib)
+    if not built:  # the loader builds on demand; try a quiet make
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "csrc"), "lib"],
+                           capture_output=True, text=True, timeout=300)
+        built = r.returncode == 0 and os.path.exists(lib)
+    emit("native_toolchain", ok=tools["g++"] and tools["make"] and built,
+         **tools, loader_built=built)
+
+
+def check_loader() -> None:
+    import tempfile
+    try:
+        from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
+        from distributeddeeplearning_tpu.data import resolve_loader
+        with tempfile.TemporaryDirectory() as d:
+            os.makedirs(os.path.join(d, "train", "class0"))
+            cfg = TrainConfig(data=DataConfig(synthetic=False, data_dir=d,
+                                              loader="auto"))
+            emit("data_loader", ok=True,
+                 auto_resolves_to=resolve_loader(cfg, "image"))
+    except Exception as e:
+        emit("data_loader", ok=False, error=str(e)[:200])
+
+
+def check_caches() -> None:
+    cache = os.path.join(REPO, ".cache", "jax_compile")
+    entries = (len(os.listdir(cache)) if os.path.isdir(cache) else 0)
+    size_mb = 0.0
+    if entries:
+        size_mb = sum(os.path.getsize(os.path.join(cache, f))
+                      for f in os.listdir(cache)) / 1e6
+    last = None
+    try:
+        with open(os.path.join(REPO, ".cache", "last_bench.json")) as f:
+            table = json.load(f)
+        key = "resnet50_imagenet_images_per_sec_per_chip"
+        last = table.get(key) if isinstance(table, dict) else None
+    except (OSError, ValueError):
+        pass
+    emit("caches", ok=True, compile_cache_entries=entries,
+         compile_cache_mb=round(size_mb, 1),
+         last_bench=({k: last[k] for k in ("value", "measured_at")}
+                     if isinstance(last, dict) else None))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--probe-timeout", type=int, default=45)
+    args = p.parse_args(argv)
+    check_accelerator(args.probe_timeout)
+    check_cpu_mesh()
+    check_versions()
+    check_native()
+    check_loader()
+    check_caches()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Step-time A/B: --fused-block (conv-epilogue fusion) vs the unfused path.
+
+    python tools/ab_fused_block.py [--batches 256,512] [--steps 20]
+        [--model resnet50] [--platform cpu]
+
+One JSON line per batch size: unfused and fused img/s/chip and the
+speedup. Run on a live chip (tools/chip_window.sh step 3 calls this);
+--platform cpu exists for smoke-testing the harness itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def step_rate(model: str, batch: int, steps: int, **flags) -> float:
+    import jax
+
+    from distributeddeeplearning_tpu import data as datalib
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = TrainConfig(model=model, global_batch_size=batch,
+                      dtype="bfloat16", log_every=10**9,
+                      parallel=ParallelConfig(data=1),
+                      data=DataConfig(synthetic=True), **flags)
+    mesh, m, shd, state, train_step, _, rng = loop.build(cfg, 64)
+    src = datalib.make_source(cfg, "image", shd)
+    i, metrics = 0, None
+    for _ in range(5):
+        state, metrics = train_step(state, src.batch(i), rng)
+        i += 1
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, src.batch(i), rng)
+        i += 1
+    jax.device_get(metrics)
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batches", default="256,512")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    for batch in (int(b) for b in args.batches.split(",")):
+        try:
+            base = step_rate(args.model, batch, args.steps)
+            fused = step_rate(args.model, batch, args.steps,
+                              fused_block=True)
+            print(json.dumps({
+                "check": "fused_block_ab", "model": args.model,
+                "batch": batch, "unfused": round(base, 1),
+                "fused": round(fused, 1),
+                "speedup": round(fused / base, 3)}), flush=True)
+        except Exception as e:  # one OOM must not sink the other batches
+            print(json.dumps({
+                "check": "fused_block_ab", "model": args.model,
+                "batch": batch,
+                "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

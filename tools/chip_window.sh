@@ -22,45 +22,8 @@ timeout 1500 python bench.py --suite --budget 1400 \
 note suite
 
 # 3. Fused-block step A/B vs unfused (the round-3 kernel project).
-timeout 900 python - > "$RES/fused_block_ab.json" 2>> "$RES/log.txt" <<'EOF'
-import json, sys, time
-sys.path.insert(0, ".")
-from distributeddeeplearning_tpu import data as datalib
-from distributeddeeplearning_tpu.config import (DataConfig, ParallelConfig,
-                                                TrainConfig)
-from distributeddeeplearning_tpu.models import model_spec
-from distributeddeeplearning_tpu.train import loop
-import jax
-
-def step_rate(batch, steps=20, **flags):
-    cfg = TrainConfig(model="resnet50", global_batch_size=batch,
-                      dtype="bfloat16", log_every=10**9,
-                      parallel=ParallelConfig(data=1),
-                      data=DataConfig(synthetic=True), **flags)
-    mesh, model, shd, state, train_step, _, rng = loop.build(cfg, 64)
-    src = datalib.make_source(cfg, "image", shd)
-    i, metrics = 0, None
-    for _ in range(5):
-        state, metrics = train_step(state, src.batch(i), rng); i += 1
-    jax.device_get(metrics)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = train_step(state, src.batch(i), rng); i += 1
-    jax.device_get(metrics)
-    return batch * steps / (time.perf_counter() - t0)
-
-for batch in (256, 512):
-    try:
-        base = step_rate(batch)
-        fused = step_rate(batch, fused_block=True)
-        print(json.dumps({"check": "fused_block_ab", "batch": batch,
-                          "unfused": round(base, 1), "fused": round(fused, 1),
-                          "speedup": round(fused / base, 3)}), flush=True)
-    except Exception as e:
-        print(json.dumps({"check": "fused_block_ab", "batch": batch,
-                          "error": f"{type(e).__name__}: {e}"[:300]}),
-              flush=True)
-EOF
+timeout 900 python tools/ab_fused_block.py --batches 256,512 \
+  > "$RES/fused_block_ab.json" 2>> "$RES/log.txt"
 note fused_block
 
 # 4. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).

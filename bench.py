@@ -55,6 +55,10 @@ BASELINE_DENOMINATOR_NOTE = (
     "V100 AMP ResNet50 1450 img/s — literature stand-in per chip for the "
     "8xV100-on-v5e-8 north star; BASELINE.json published={}")
 RETRY_BACKOFF_SEC = (5, 15)  # sleeps between attempts
+# Child->parent heartbeat marker: the parent's preflight deadline disarms on
+# this substring, so the child's backend-up note and the parent's matcher
+# must never drift apart.
+BACKEND_UP_HEARTBEAT = "backend up:"
 COMPILE_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".cache", "jax_compile")
 
@@ -256,7 +260,7 @@ def _child(args) -> int:
     t0 = time.perf_counter()
     _note("initializing backend")
     n_dev = jax.device_count()
-    _note(f"backend up: {n_dev} x {jax.devices()[0].platform} in "
+    _note(f"{BACKEND_UP_HEARTBEAT} {n_dev} x {jax.devices()[0].platform} in "
           f"{time.perf_counter() - t0:.1f}s")
 
     if not args.suite:
@@ -342,13 +346,21 @@ def _emit_error(args, msg: str) -> None:
     # Context for the reader, NOT a measurement: the newest number this
     # harness captured on a live chip (value above stays null — a dead
     # backend yields no result, but the record should say what the same
-    # command measured when the chip last answered).
+    # command measured when the chip last answered). ``stale_age_s`` is
+    # top-level so a consumer can judge freshness without digging the
+    # timestamp out of the nested record.
     try:
         with open(LAST_GOOD_PATH) as f:
             table = json.load(f)
         prior = table.get(metric) if isinstance(table, dict) else None
         if isinstance(prior, dict) and prior.get("metric") == metric:
             rec["last_measured_on_live_chip"] = prior
+            try:
+                measured = time.mktime(time.strptime(
+                    prior["measured_at"], "%Y-%m-%d %H:%M:%S"))
+                rec["stale_age_s"] = max(0, int(time.time() - measured))
+            except (KeyError, ValueError, TypeError, OverflowError):
+                pass
     except (OSError, ValueError):
         pass
     print(json.dumps(rec), flush=True)
@@ -366,7 +378,8 @@ def _parse_record(line: str):
 
 
 def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
-                 record_good: bool = True) -> tuple[int, str, object]:
+                 record_good: bool = True,
+                 preflight: float = 0) -> tuple[int, str, object]:
     """Run one child, RELAYING metric lines to stdout as they appear.
 
     Returns (num_measurements_relayed, stderr_tail, rc). The relay is the
@@ -374,12 +387,23 @@ def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
     ``relay_errors`` (suite mode) also passes through per-config error
     records so a failed row is visible, not silently absent; default mode
     keeps them back because the driver takes the LAST parseable line and an
-    error record must never shadow a real measurement."""
+    error record must never shadow a real measurement.
+
+    ``preflight`` > 0 arms a fail-fast deadline on backend init: the child
+    prints a ``# bench: backend up`` heartbeat the moment ``jax.devices()``
+    returns (seconds on a live tunnel), but a DOWN tunnel makes that call
+    hang indefinitely — so if neither the heartbeat nor a metric line has
+    appeared within ``preflight`` seconds the child is killed and rc is the
+    sentinel ``preflight ...`` string. This costs nothing on a live chip
+    (the deadline disarms at the heartbeat, before compilation starts) and
+    turns a dead-tunnel run from 3 x attempt_timeout of hangs into one
+    short probe, leaving the driver's window open for a later retry."""
     env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=COMPILE_CACHE_DIR)
     proc = subprocess.Popen(child_cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, env=env)
     relayed = [0, 0]  # [measurements, error records]
     err_lines: list[str] = []
+    backend_up = threading.Event()
 
     def _pump_out():
         for line in proc.stdout:
@@ -387,6 +411,7 @@ def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
             rec = _parse_record(line)
             if rec is None:
                 continue
+            backend_up.set()  # any metric line proves the backend answered
             if rec.get("value") is not None:
                 print(line, flush=True)
                 relayed[0] += 1
@@ -399,6 +424,8 @@ def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
 
     def _pump_err():
         for line in proc.stderr:
+            if BACKEND_UP_HEARTBEAT in line:
+                backend_up.set()
             err_lines.append(line.rstrip())
             del err_lines[:-40]
 
@@ -406,12 +433,26 @@ def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
                threading.Thread(target=_pump_err, daemon=True)]
     for t in threads:
         t.start()
-    try:
-        rc: object = proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait()
-        rc = f"timeout {int(timeout)}s"
+    start = time.monotonic()
+    rc: object = None
+    while True:
+        try:
+            rc = proc.wait(timeout=1)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        elapsed = time.monotonic() - start
+        if preflight and not backend_up.is_set() and elapsed >= preflight:
+            proc.kill()
+            proc.wait()
+            rc = (f"preflight {int(preflight)}s: backend never came up "
+                  f"(tunnel presumed down)")
+            break
+        if elapsed >= timeout:
+            proc.kill()
+            proc.wait()
+            rc = f"timeout {int(timeout)}s"
+            break
     for t in threads:
         t.join(timeout=5)
     return relayed[0] + relayed[1], "\n".join(err_lines), rc
@@ -468,6 +509,14 @@ def main(argv=None) -> int:
                         "parent time to print the error record before any "
                         "outer driver timeout")
     p.add_argument("--attempts", type=int, default=3)
+    p.add_argument("--preflight-timeout", type=int, default=75,
+                   help="fail-fast deadline (s) on backend init: if the "
+                        "child's 'backend up' heartbeat hasn't appeared "
+                        "within this window the tunnel is presumed down and "
+                        "the error record is emitted immediately instead of "
+                        "burning attempts x attempt_timeout on hangs; 0 "
+                        "disables (live-chip init lands in seconds, so 75s "
+                        "is generous)")
     p.add_argument("--budget", type=int, default=1200,
                    help="total wall-clock budget across all attempts (s); "
                         "guarantees the error record is printed before any "
@@ -534,7 +583,8 @@ def main(argv=None) -> int:
             break
         n_lines, err_tail, rc = _run_attempt(
             child_cmd, timeout=min(args.attempt_timeout, remaining),
-            relay_errors=args.suite, record_good=not args.platform)
+            relay_errors=args.suite, record_good=not args.platform,
+            preflight=args.preflight_timeout)
         if args.suite and n_lines and rc != 0:
             # Child died mid-suite: partial rows are already on stdout (and
             # stay valid), but flag the incompleteness on stderr. No error
@@ -548,6 +598,11 @@ def main(argv=None) -> int:
             # that then hung or died cannot take it back.
             return 0
         last_err = f"attempt {attempt + 1}: rc={rc}: {err_tail[-600:]}"
+        if isinstance(rc, str) and rc.startswith("preflight"):
+            # Backend init hung: further attempts would hang identically.
+            # Exit NOW so the total dead-tunnel runtime is one preflight
+            # window, not attempts x attempt_timeout.
+            break
 
     _emit_error(args, last_err)
     return 0

@@ -136,6 +136,70 @@ def test_sweep_emits_only_if_faster(bench, monkeypatch, capsys):
     assert "sweep" in out[-1]["protocol"]
 
 
+def test_preflight_kills_hung_backend_fast(bench):
+    # A child that never prints the backend-up heartbeat models a down
+    # tunnel (jax.devices() hangs). The attempt must die at the preflight
+    # deadline, not the full timeout.
+    import time as _time
+    t0 = _time.monotonic()
+    n, _err, rc = bench._run_attempt(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        timeout=60, relay_errors=False, preflight=2)
+    assert n == 0
+    assert isinstance(rc, str) and rc.startswith("preflight")
+    assert _time.monotonic() - t0 < 20
+
+
+def test_preflight_disarmed_by_backend_heartbeat(bench):
+    # Once the heartbeat lands, the preflight deadline must NOT fire; the
+    # ordinary attempt timeout governs from then on.
+    # Emit the real heartbeat constant so this test fails if the child's
+    # note and the parent's matcher ever drift apart.
+    child = (f"import sys, time; "
+             f"print('# bench: {bench.BACKEND_UP_HEARTBEAT} 1 x tpu', "
+             f"file=sys.stderr, flush=True); time.sleep(60)")
+    n, _err, rc = bench._run_attempt(
+        [sys.executable, "-c", child],
+        timeout=6, relay_errors=False, preflight=2)
+    assert n == 0
+    assert isinstance(rc, str) and rc.startswith("timeout")
+
+
+def test_preflight_failure_skips_remaining_attempts(bench, monkeypatch,
+                                                    capsys):
+    calls = []
+
+    def fake_attempt(cmd, timeout, *, relay_errors, record_good=True,
+                     preflight=0):
+        calls.append(preflight)
+        return 0, "", "preflight 75s: backend never came up"
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    rc = bench.main(["--attempts", "3"])
+    assert rc == 0
+    assert len(calls) == 1  # no retries against a hung backend
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    assert out[-1]["value"] is None
+    assert "preflight" in out[-1]["error"]
+
+
+def test_error_record_carries_stale_age(bench, capsys):
+    import time as _time
+    measured = _time.strftime("%Y-%m-%d %H:%M:%S",
+                              _time.localtime(_time.time() - 3600))
+    bench._record_last_good(json.dumps({
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": 2000.0, "measured_at": measured}))
+    args = _args(bench, ["--model", "resnet50"])
+    bench._emit_error(args, "tunnel down")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert rec["last_measured_on_live_chip"]["value"] == 2000.0
+    # Top-level age: ~1h, with slack for slow test boxes.
+    assert 3500 <= rec["stale_age_s"] <= 3800
+
+
 def test_last_good_cache_keyed_per_metric(bench, tmp_path):
     bench._record_last_good(json.dumps({"metric": "a", "value": 1}))
     bench._record_last_good(json.dumps({"metric": "b", "value": 2}))

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Poll for the axon TPU tunnel; the moment a probe succeeds, launch the
+# batched measurement script (tools/chip_window.sh) and exit.
+#
+# Usage: tools/tpu_watch.sh [deadline_seconds]   (default 10.5h)
+# The deadline exists so the poller can never contend with the driver's own
+# end-of-round bench run. Probes use `timeout 45` because a down tunnel makes
+# `jax.devices()` hang indefinitely rather than fail fast.
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE=${1:-37800}
+START=$(date +%s)
+LOG=.chip_results/watch.log
+mkdir -p .chip_results
+echo "[$(date +%H:%M:%S)] watcher start, deadline ${DEADLINE}s" >> "$LOG"
+while :; do
+  now=$(date +%s)
+  if (( now - START > DEADLINE )); then
+    echo "[$(date +%H:%M:%S)] deadline reached, no window" >> "$LOG"
+    exit 1
+  fi
+  if timeout 45 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >> "$LOG" 2>&1; then
+    echo "[$(date +%H:%M:%S)] TUNNEL UP — launching chip_window.sh" >> "$LOG"
+    nohup bash tools/chip_window.sh >> "$LOG" 2>&1 &
+    exit 0
+  fi
+  sleep 90
+done

@@ -209,28 +209,50 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
     return jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
 
 
+def _map_batched_cache(cache, fn):
+    """Apply ``fn`` to the batched K/V cache leaves (``cached_key`` /
+    ``cached_value``), leave the per-layer scalar write indices alone, and
+    REJECT any leaf name this function has never been taught — a new cache
+    entry must be classified here explicitly, not silently guessed from its
+    leading-dim size (ADVICE r3 #3)."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(cache)
+    for path, x in flat.items():
+        if path[-1] in ("cached_key", "cached_value"):
+            flat[path] = fn(x)
+        elif path[-1] not in ("cache_index", "position"):
+            raise ValueError(
+                f"unknown decode-cache leaf {'/'.join(map(str, path))}: "
+                f"beam search must know whether to expand/reorder it "
+                f"(batched, like cached_key) or share it (scalar, like "
+                f"cache_index) — add it to _map_batched_cache")
+    return traverse_util.unflatten_dict(flat)
+
+
 def _beam_cached(model, variables, prompt_ids, ids0, scores0, finished0,
                  select, *, total: int, num_beams: int):
     """KV-cache beam search: prefill once at batch B, expand the cache to
     B*K beam rows, then per step reorder caches by surviving parent beam
-    and run one single-token forward. The last iteration's forward feeds
-    no selection (its logits are discarded) — one redundant token-forward
-    per generation, kept for scan-shape simplicity."""
-    _require_decode(model, total)
+    and run one single-token forward. The final token needs only a
+    selection, not a forward, so the scan stops one step early and the
+    last ``select`` runs outside it — no wasted forward, and no write at
+    cache index == capacity (whose dynamic_update_slice start-clamp would
+    silently corrupt the last K/V slot, ADVICE r3 #4)."""
     b, p = prompt_ids.shape
     k = num_beams
+    if total == p:  # max_new_tokens == 0: nothing to select or forward —
+        return ids0, scores0, finished0  # the trailing select below would
+        # otherwise overwrite the last PROMPT token at position p-1.
+    _require_decode(model, total)
 
     fresh = {key: v for key, v in variables.items() if key != "cache"}
     logits0, mut = model.apply(fresh, prompt_ids, train=False,
                                decode=True, mutable=["cache"])
 
-    def expand(x):
-        # (B, ...) cache rows -> (B*K, ...): row b*K+j is beam j of batch b.
-        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b:
-            return jnp.repeat(x, k, axis=0)
-        return x  # per-layer scalar write indices stay shared
-
-    cache0 = jax.tree_util.tree_map(expand, mut["cache"])
+    # (B, ...) cache rows -> (B*K, ...): row b*K+j is beam j of batch b.
+    cache0 = _map_batched_cache(mut["cache"],
+                                lambda x: jnp.repeat(x, k, axis=0))
     next0 = jnp.repeat(logits0[:, -1], k, axis=0)           # (B*K, V)
     batch_base = jnp.arange(b)[:, None] * k
 
@@ -239,21 +261,18 @@ def _beam_cached(model, variables, prompt_ids, ids0, scores0, finished0,
         ids, scores, finished, beam_idx, tok = select(
             next_logits, ids, scores, finished, t)
         flat = (batch_base + beam_idx).reshape(-1)
-
-        def reorder(x):
-            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == b * k:
-                return jnp.take(x, flat, axis=0)
-            return x
-
-        cache = jax.tree_util.tree_map(reorder, cache)
+        cache = _map_batched_cache(cache,
+                                   lambda x: jnp.take(x, flat, axis=0))
         logits, mut = model.apply(
             {**fresh, "cache": cache}, tok.reshape(b * k, 1),
             train=False, decode=True, mutable=["cache"])
         return (ids, scores, finished, mut["cache"], logits[:, -1]), None
 
-    (ids, scores, finished, _, _), _ = jax.lax.scan(
+    (ids, scores, finished, _, next_logits), _ = jax.lax.scan(
         step, (ids0, scores0, finished0, cache0, next0),
-        jnp.arange(p, total))
+        jnp.arange(p, total - 1))
+    ids, scores, finished, _, _ = select(
+        next_logits, ids, scores, finished, total - 1)
     return ids, scores, finished
 
 

@@ -33,7 +33,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     dropout_rate: float = 0.0       # llama pretraining uses no dropout
-    attention_impl: str = "dense"   # dense | flash | ring (causal)
+    attention_impl: str = "dense"   # dense | flash | ring | zigzag (causal)
     remat: bool = False
     # KV-cache buffer length for decode mode (RoPE has no position table,
     # so this is the only static sequence bound generation needs).
@@ -57,15 +57,18 @@ def _rms_norm(cfg: LlamaConfig, dtype, name: str):
                       param_dtype=jnp.float32, name=name)
 
 
-def apply_rope(x, *, theta: float, offset=0):
+def apply_rope(x, *, theta: float, offset=0, positions=None):
     """Rotary embedding, half-split (rotate_half) convention: x (B, S, H, D)
     rotated by (offset + index) along dim 1 — ``offset`` (may be traced)
-    positions a decode-mode single token at its absolute index. f32
+    positions a decode-mode single token at its absolute index, while
+    ``positions`` (an (S,) int array) overrides the arange entirely for
+    layouts where slot != absolute position (the zigzag permutation). f32
     rotation regardless of storage dtype (sin/cos in bf16 visibly degrades
     long-range phase)."""
     b, s, h, d = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = offset + jnp.arange(s, dtype=jnp.float32)
+    pos = (jnp.asarray(positions, jnp.float32) if positions is not None
+           else offset + jnp.arange(s, dtype=jnp.float32))
     ang = pos[:, None] * freqs[None, :]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -81,7 +84,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False):
+                 decode: bool = False, positions=None):
         cfg = self.cfg
         b, s, _ = x.shape
         d = cfg.head_dim
@@ -93,8 +96,13 @@ class LlamaAttention(nn.Module):
                    self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
         if decode:
             return self._decode_step(q, k, v)
-        q = apply_rope(q, theta=cfg.rope_theta)
-        k = apply_rope(k, theta=cfg.rope_theta)
+        # ``positions`` carries the zigzag permutation: in that layout slot
+        # i holds absolute token perm[i], and RoPE's rotation must follow
+        # the token, not the slot, for the causal geometry to survive the
+        # relayout (the attention impl compares permuted *positions*, so
+        # q·k phase differences must encode true distances).
+        q = apply_rope(q, theta=cfg.rope_theta, positions=positions)
+        k = apply_rope(k, theta=cfg.rope_theta, positions=positions)
         if cfg.num_kv_heads != cfg.num_heads:
             # GQA: repeat KV groups to full heads for the shared attention
             # impls (saves KV *parameters/cache*; attention compute matches
@@ -162,11 +170,12 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False):
+                 decode: bool = False, positions=None):
         cfg = self.cfg
         h = _rms_norm(cfg, self.dtype, "attention_norm")(x)
         h = LlamaAttention(cfg, self.dtype, name="attention")(
-            h, pad_mask, deterministic=deterministic, decode=decode)
+            h, pad_mask, deterministic=deterministic, decode=decode,
+            positions=positions)
         x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = _rms_norm(cfg, self.dtype, "mlp_norm")(x)
         gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
@@ -189,20 +198,34 @@ class LlamaLM(nn.Module):
     def __call__(self, input_ids, attention_mask=None, *,
                  train: bool = True, decode: bool = False):
         cfg = self.cfg
-        if cfg.attention_impl == "zigzag":
-            # zigzag needs the whole model run in permuted layout with
-            # positions mapped through the permutation (models/gpt.py does
-            # this for learned positions); RoPE's rotation indices are not
-            # wired through yet — reject rather than silently attend over
-            # a mislabeled layout.
-            raise ValueError(
-                "attention_impl='zigzag' is not wired for the Llama family "
-                "yet (RoPE positions must follow the zigzag permutation); "
-                "use 'ring' or 'flash'")
         deterministic = not train
         b, s = input_ids.shape
         pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
                     else attention_mask.astype(jnp.bool_))
+
+        # Zigzag layout (load-balanced causal ring): same whole-model
+        # permuted-layout scheme as models/gpt.py — ids/mask permuted once
+        # here, hidden states unpermuted once before the head. GPT feeds the
+        # permutation to its learned position TABLE; RoPE has no table, so
+        # the permutation rides into every attention layer as the rotation
+        # indices instead (``positions``). RMSNorm/SwiGLU/residuals are
+        # positionwise and thus permutation-oblivious.
+        inv = positions = None
+        if cfg.attention_impl == "zigzag" and not decode:
+            from distributeddeeplearning_tpu.parallel.ring_attention import (
+                zigzag_indices)
+            ambient = jax.sharding.get_abstract_mesh()
+            n_seq = (ambient.shape.get("seq", 1)
+                     if ambient is not None and not ambient.empty else 1)
+            if n_seq > 1:
+                if s % (2 * n_seq):
+                    raise ValueError(
+                        f"attention_impl='zigzag' needs seq_len divisible "
+                        f"by 2*seq_shards (= {2 * n_seq}); got {s}")
+                perm, inv = zigzag_indices(s, n_seq)
+                input_ids = input_ids[:, perm]
+                pad_mask = pad_mask[:, perm]
+                positions = jnp.asarray(perm)
 
         embed = self.param(
             "embed_tokens",
@@ -216,14 +239,20 @@ class LlamaLM(nn.Module):
             block = LlamaBlock(cfg, self.dtype, name=f"layer{i}")
             if cfg.remat and not decode:
                 x = nn.remat(
-                    lambda mdl, h, m: mdl(
-                        h, m, deterministic=deterministic))(
-                    block, x, pad_mask)
+                    lambda mdl, h, m, p: mdl(
+                        h, m, deterministic=deterministic, positions=p))(
+                    block, x, pad_mask, positions)
             else:
                 x = block(x, pad_mask, deterministic=deterministic,
-                          decode=decode)
+                          decode=decode, positions=positions)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
+        if inv is not None:
+            # Natural order restored BEFORE the head — callers keep the
+            # standard position-aligned logits contract (see models/gpt.py
+            # for the hidden-vs-logits traffic argument).
+            x = x[:, jnp.asarray(inv)]
+            x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         x = _rms_norm(cfg, self.dtype, "final_norm")(x)
         logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
                         self.dtype)(x)

@@ -70,12 +70,65 @@ class Checkpointer:
 
     def restore_latest(self, state_like: Any) -> Optional[Any]:
         """Restore the newest checkpoint into ``state_like``'s layout, or
-        None when the directory is empty (fresh run)."""
+        None when the directory is empty (fresh run).
+
+        ``ema_params`` presence may legitimately differ from the checkpoint:
+        ``--ema-decay`` can be turned on mid-experiment (resume a pre-EMA
+        checkpoint) — the shadow is then seeded from the restored params,
+        exactly how a fresh run seeds it from init. The reverse (checkpoint
+        carries a trained EMA but the resume dropped the flag) is rejected
+        loudly: silently discarding trained state contradicts the repo's
+        dead-knob policy, and before this check it surfaced as an opaque
+        orbax structure-mismatch error (ADVICE r3 #2)."""
         step = self._mgr.latest_step()
         if step is None:
             return None
+        want_ema = state_like.ema_params is not None
+        ckpt_ema = self._ckpt_has_ema(step)
+        if ckpt_ema is None:  # unreadable metadata: keep the strict restore
+            ckpt_ema = want_ema
+        if ckpt_ema and not want_ema:
+            raise ValueError(
+                f"checkpoint step {step} carries EMA shadow params but this "
+                f"run did not set --ema-decay. Resuming would silently drop "
+                f"the trained EMA. Repeat the original --ema-decay to "
+                f"continue it, or start a fresh --checkpoint-dir.")
+        if want_ema and not ckpt_ema:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint step {step} predates --ema-decay: seeding the "
+                f"EMA shadow from the restored params (the same way a fresh "
+                f"run seeds it from init).")
+            restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
+                _abstract_like(state_like.replace(ema_params=None))))
+            return restored.replace(ema_params=restored.params)
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(_abstract_like(state_like)))
+
+    def _ckpt_has_ema(self, step: int) -> Optional[bool]:
+        """Whether checkpoint ``step`` carries real EMA arrays, from the
+        StandardSave ``_METADATA`` manifest on disk. (A fresh
+        CheckpointManager's ``item_metadata`` cannot reconstruct the item
+        without a handler registry in this orbax version — it returns a
+        tree of None with an absl warning — so the file is the reliable
+        source.) None = manifest unreadable; caller falls back to the
+        strict structure-matched restore."""
+        path = os.path.join(str(self._mgr.directory), str(step), "default",
+                            "_METADATA")
+        try:
+            with open(path) as f:
+                tree_meta = json.load(f)["tree_metadata"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        for key, entry in tree_meta.items():
+            if key.startswith("('ema_params'"):
+                # The None placeholder is a single ('ema_params',) entry of
+                # value_type 'None'; real EMA shows array entries instead.
+                value_type = entry.get("value_metadata", {}).get("value_type")
+                if value_type not in ("None", None):
+                    return True
+        return False
 
     def _restore_subtree(self, raw_subtree: Any, like: Any, what: str) -> Any:
         """Unwrap serialized sharding boxes, check structure AND shapes

@@ -60,6 +60,48 @@ def test_grads_match_dense():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_non_power_of_two_seq_padded_not_degenerate():
+    """S=197 (ViT-with-CLS shape; prime) used to resolve _block to 1 — a
+    degenerate 197-step grid. flash_attention now pads S to a lane multiple
+    (256) so blocks stay >= 128, and the padded rows/keys must not leak
+    into the result or the gradients."""
+    from distributeddeeplearning_tpu.ops.flash_attention import _block
+
+    s = 197
+    q, k, v = random_qkv(jax.random.key(4), s=s)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_reference(q, k, v)),
+        rtol=1e-5, atol=1e-5)
+    # causal too (the causal block-skip indexes blocks; padding must not
+    # shift the diagonal).
+    out_c = flash_attention(q, k, v, block_q=128, block_k=128, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_c),
+        np.asarray(dense_reference(q, k, v, causal=True)),
+        rtol=1e-5, atol=1e-5)
+
+    gf = jax.grad(lambda *a: (flash_attention(
+        *a, block_q=128, block_k=128) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: (dense_reference(*a) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+    # The invariant the pad exists to protect — and the loud warning any
+    # future direct kernel caller sees instead of the silent cliff. A
+    # modestly-smaller block (48 for target 64) stays silent: that is a
+    # working configuration, not a cliff.
+    assert _block(256, 128) == 128
+    with pytest.warns(UserWarning, match="degenerated"):
+        assert _block(197, 128) == 1
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert _block(96, 64) == 48
+
+
 def test_bfloat16_forward():
     q, k, v = random_qkv(jax.random.key(3), dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, block_q=32, block_k=32)

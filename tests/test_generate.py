@@ -289,6 +289,42 @@ def test_cached_beam_overflow_guard():
                       num_beams=2, use_cache=True)
 
 
+def test_cached_beam_zero_new_tokens_returns_prompt():
+    """max_new_tokens=0 must return the prompt untouched in BOTH beam
+    paths — the cached path's trailing out-of-scan select must not fire."""
+    from distributeddeeplearning_tpu.models.generate import generate_beam
+
+    model, variables = _tiny("gpt")
+    prompt = np.ones((2, 4), np.int32) * 3
+    for use_cache in (False, True):
+        out = generate_beam(model, variables, prompt, max_new_tokens=0,
+                            num_beams=2, use_cache=use_cache)
+        np.testing.assert_array_equal(np.asarray(out), prompt,
+                                      err_msg=f"use_cache={use_cache}")
+
+
+def test_beam_cache_map_rejects_unknown_leaf():
+    """Cache leaves are classified by NAME; a leaf beam search was never
+    taught must be rejected, not silently guessed from its leading-dim
+    size (which mis-expands whenever the size coincides with the batch)."""
+    from distributeddeeplearning_tpu.models.generate import (
+        _map_batched_cache)
+
+    cache = {"layer0": {"cached_key": jnp.zeros((2, 4, 2, 8)),
+                        "cache_index": jnp.zeros((), jnp.int32),
+                        "mystery_state": jnp.zeros((2,))}}
+    with pytest.raises(ValueError, match="mystery_state"):
+        _map_batched_cache(cache, lambda x: x)
+    # And the known layout maps only the batched leaves.
+    out = _map_batched_cache(
+        {"layer0": {"cached_key": jnp.zeros((2, 3)),
+                    "cached_value": jnp.ones((2, 3)),
+                    "cache_index": jnp.zeros((), jnp.int32)}},
+        lambda x: jnp.repeat(x, 2, axis=0))
+    assert out["layer0"]["cached_key"].shape == (4, 3)
+    assert out["layer0"]["cache_index"].shape == ()
+
+
 def test_speculative_matches_target_greedy():
     """Speculative decoding's whole contract: EXACTLY the target model's
     greedy continuation, regardless of what the draft proposes."""

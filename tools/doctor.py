@@ -84,10 +84,14 @@ def check_native() -> None:
     lib = os.path.join(REPO, "distributeddeeplearning_tpu", "data",
                        "_native", "libddl_loader.so")
     built = os.path.exists(lib)
-    if not built:  # the loader builds on demand; try a quiet make
-        r = subprocess.run(["make", "-C", os.path.join(REPO, "csrc"), "lib"],
-                           capture_output=True, text=True, timeout=300)
-        built = r.returncode == 0 and os.path.exists(lib)
+    if not built and tools["make"]:  # the loader builds on demand
+        try:
+            r = subprocess.run(
+                ["make", "-C", os.path.join(REPO, "csrc"), "lib"],
+                capture_output=True, text=True, timeout=300)
+            built = r.returncode == 0 and os.path.exists(lib)
+        except (subprocess.TimeoutExpired, OSError):
+            built = False  # report, never raise: doctor must finish
     emit("native_toolchain", ok=tools["g++"] and tools["make"] and built,
          **tools, loader_built=built)
 

@@ -89,10 +89,11 @@ class SelfAttention(nn.Module):
             multihead_attention)
         out = multihead_attention(
             q, k, v, mask, impl=cfg.attention_impl, causal=False,
-            dtype=self.dtype,
-            prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
-                p, deterministic=deterministic),
-            warn_dropout_rate=cfg.dropout_rate, deterministic=deterministic)
+            dtype=self.dtype, dropout_rate=cfg.dropout_rate,
+            dropout_rng=(self.make_rng("dropout")
+                         if not deterministic and cfg.dropout_rate > 0
+                         else None),
+            deterministic=deterministic)
         # Output projection: input dim sharded -> XLA reduces over tp axis.
         return _dense(cfg.hidden_size, ("heads", "embed"), "output", self.dtype)(out)
 

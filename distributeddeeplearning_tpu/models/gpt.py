@@ -110,10 +110,10 @@ class CausalSelfAttention(nn.Module):
                 multihead_attention)
             out = multihead_attention(
                 q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
-                dtype=self.dtype,
-                prob_dropout=lambda p: nn.Dropout(cfg.dropout_rate)(
-                    p, deterministic=deterministic),
-                warn_dropout_rate=cfg.dropout_rate,
+                dtype=self.dtype, dropout_rate=cfg.dropout_rate,
+                dropout_rng=(self.make_rng("dropout")
+                             if not deterministic and cfg.dropout_rate > 0
+                             else None),
                 deterministic=deterministic)
         return _dense(cfg.hidden_size, ("heads", "embed"), "output",
                       self.dtype)(out)

@@ -113,9 +113,16 @@ class LlamaAttention(nn.Module):
 
         from distributeddeeplearning_tpu.ops.attention import (
             multihead_attention)
+        # cfg.dropout_rate defaults to 0 (the canonical Llama recipe); a
+        # user who opts in gets the same attention-probability dropout as
+        # every other family, in every impl (ops/attention.py contract).
         out = multihead_attention(
             q, k, v, pad_mask, impl=cfg.attention_impl, causal=True,
-            dtype=self.dtype, deterministic=deterministic)
+            dtype=self.dtype, dropout_rate=cfg.dropout_rate,
+            dropout_rng=(self.make_rng("dropout")
+                         if not deterministic and cfg.dropout_rate > 0
+                         else None),
+            deterministic=deterministic)
         return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
                       self.dtype)(out)
 

@@ -75,9 +75,33 @@ def _block(size: int, target: int) -> int:
 # Forward: grid (B*H, nQ, nK); m/l/acc scratch carries across the K axis.
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
+def _block_keep(seed_ref, pid, i, j, bq: int, bk: int, rate: float):
+    """The (BQ, BK) keep-mask for block (i, j) of grid row ``pid``
+    (= pl.program_id(0), hoisted to the kernel top level — program_id may
+    not be bound under a pl.when body), in GLOBAL coordinates — the same
+    mask regardless of which kernel (forward, dq, dk/dv) or block geometry
+    asks for it. seed_ref (SMEM): [seed, b_start, h_start, h_local,
+    h_total] — the last four place this shard's (batch, head) range in the
+    global index space so the realized mask is sharding-invariant
+    (dense == flash at any dp x tp)."""
+    from distributeddeeplearning_tpu.ops.hash_dropout import keep_mask
+
+    h_n = seed_ref[3]
+    bh = ((seed_ref[1] + pid // h_n) * seed_ref[4]
+          + seed_ref[2] + pid % h_n)
+    rows = (jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
+            + (i * bq).astype(jnp.uint32))
+    cols = (jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+            + (j * bk).astype(jnp.uint32))
+    return keep_mask(seed_ref[0], jnp.uint32(0) + bh.astype(jnp.uint32),
+                     rows, cols, rate)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                dropout_rate: float):
     i, j = pl.program_id(1), pl.program_id(2)
+    pid0 = pl.program_id(0)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(j == 0)
@@ -108,7 +132,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         m_scr[:] = m_new
+        # l accumulates UNdropped p: dense semantics normalize first
+        # (softmax), then drop — o = (softmax ∘ keep/(1-r)) v.
         l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _block_keep(seed_ref, pid0, i, j, bq, bk, dropout_rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -131,7 +160,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
             l[:, 0] > 0, m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
 
 
-def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret, causal):
+def _fwd(q, k, v, mask, seed, *, scale, block_q, block_k, interpret, causal,
+         dropout_rate):
     # Rank-1-per-tile operands (mask, lse) ride as (BH, 1, S) so every block
     # shape is rank >= 2 with a compiled-lowering-legal tail: Mosaic requires
     # the last two block dims be (multiples of, or equal to) the array dims —
@@ -140,13 +170,15 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret, causal):
     bh, s, d = q.shape
     bq, bk = _block(s, block_q), _block(s, block_k)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          dropout_rate=dropout_rate),
         grid=(bh, s // bq, s // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -164,7 +196,7 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret, causal):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask[:, None, :])
+    )(q, k, v, mask[:, None, :], seed)
     return out, lse.reshape(bh, s)
 
 
@@ -174,8 +206,10 @@ def _fwd(q, k, v, mask, *, scale, block_q, block_k, interpret, causal):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale: float, causal: bool):
+               seed_ref, dq_ref, dq_scr, *, scale: float, causal: bool,
+               dropout_rate: float):
     i, j = pl.program_id(1), pl.program_id(2)
+    pid0 = pl.program_id(0)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(j == 0)
@@ -201,6 +235,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # Regenerate the forward's exact mask. delta = sum(do*o)
+            # already IS sum_k p*m*dp (o carries the dropped probs), so the
+            # flash delta trick needs no dropout correction — only dp does:
+            # ds = p * (m*dp - delta).
+            keep = _block_keep(seed_ref, pid0, i, j, bq, bk, dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -217,9 +258,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                causal: bool):
+                seed_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, dropout_rate: float):
     j, i = pl.program_id(1), pl.program_id(2)  # j: K tile; i: Q (accum) tile
+    pid0 = pl.program_id(0)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
 
     @pl.when(i == 0)
@@ -243,12 +285,23 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * scale
         s = jnp.where(valid, s, _NEG)
         p = jnp.exp(s - lse)                              # (BQ, BK)
+        if dropout_rate > 0.0:
+            # (i, j) here are the same logical (Q-tile, K-tile) indices the
+            # forward used (the grid swaps their nesting, not their
+            # meaning), so this regenerates the forward's exact mask.
+            keep = _block_keep(seed_ref, pid0, i, j, bq, bk, dropout_rate)
+            inv_keep = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            keep, p_drop = None, p
         dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = (p * (dp - delta) * scale).astype(q.dtype)   # (BQ, BK)
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -265,30 +318,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, block_q, block_k, interpret, causal, residuals, g):
-    q, k, v, mask, out, lse = residuals
+def _bwd(scale, block_q, block_k, interpret, causal, dropout_rate,
+         residuals, g):
+    q, k, v, mask, seed, out, lse = residuals
     bh, s, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     # (BH, 1, S) lift for the rank-1-per-tile operands — see _fwd.
     mask3, lse3, delta3 = (x[:, None, :] for x in (mask, lse, delta))
 
     bq, bk = _block(s, block_q), _block(s, block_k)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     q_tile = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     k_tile = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
     maskk = pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))
     vec_q = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          dropout_rate=dropout_rate),
         grid=(bh, s // bq, s // bk),
-        in_specs=[q_tile, k_tile, k_tile, maskk, q_tile, vec_q, vec_q],
+        in_specs=[q_tile, k_tile, k_tile, maskk, q_tile, vec_q, vec_q,
+                  smem],
         out_specs=[q_tile],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask3, g, lse3, delta3)[0]
+    )(q, k, v, mask3, g, lse3, delta3, seed)[0]
 
     # dk/dv: K tiles are the revisited outputs, Q is the accumulation axis
     # (innermost grid dim), so swap the roles of the last two grid indices.
@@ -297,9 +354,11 @@ def _bwd(scale, block_q, block_k, interpret, causal, residuals, g):
     maskk2 = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))
     vec_q2 = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          dropout_rate=dropout_rate),
         grid=(bh, s // bk, s // bq),
-        in_specs=[q_acc, k_out, k_out, maskk2, q_acc, vec_q2, vec_q2],
+        in_specs=[q_acc, k_out, k_out, maskk2, q_acc, vec_q2, vec_q2,
+                  smem],
         out_specs=[k_out, k_out],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
@@ -308,21 +367,25 @@ def _bwd(scale, block_q, block_k, interpret, causal, residuals, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, mask3, g, lse3, delta3)
-    return dq, dk, dv, None
+    )(q, k, v, mask3, g, lse3, delta3, seed)
+    return dq, dk, dv, None, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, scale, block_q, block_k, interpret, causal):
-    out, _ = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
-                  block_k=block_k, interpret=interpret, causal=causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, seed, scale, block_q, block_k, interpret, causal,
+           dropout_rate):
+    out, _ = _fwd(q, k, v, mask, seed, scale=scale, block_q=block_q,
+                  block_k=block_k, interpret=interpret, causal=causal,
+                  dropout_rate=dropout_rate)
     return out
 
 
-def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret, causal):
-    out, lse = _fwd(q, k, v, mask, scale=scale, block_q=block_q,
-                    block_k=block_k, interpret=interpret, causal=causal)
-    return out, (q, k, v, mask, out, lse)
+def _flash_fwd(q, k, v, mask, seed, scale, block_q, block_k, interpret,
+               causal, dropout_rate):
+    out, lse = _fwd(q, k, v, mask, seed, scale=scale, block_q=block_q,
+                    block_k=block_k, interpret=interpret, causal=causal,
+                    dropout_rate=dropout_rate)
+    return out, (q, k, v, mask, seed, out, lse)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -330,19 +393,39 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 512,
                     block_k: int = 1024, causal: bool = False,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    dropout_rate: float = 0.0, dropout_seed=None,
+                    bh_offsets=None):
     """Fused attention with a key-padding mask; ``causal=True`` adds the
     autoregressive lower-triangular mask (and skips above-diagonal blocks).
 
     q/k/v: (B, S, H, D) — the models' layout; kv_mask: (B, S) (True/nonzero
     = attend), or None for all-valid. Returns (B, S, H, D) in q.dtype.
     Differentiable w.r.t. q/k/v via the flash backward kernels.
+
+    ``dropout_rate`` > 0 applies attention-probability dropout INSIDE the
+    kernels via a counter-based hash mask (ops/hash_dropout.py) that the
+    backward kernels regenerate exactly — no (S, S) mask ever exists.
+    ``dropout_seed``: int32 scalar (required when rate > 0). ``bh_offsets``:
+    optional (b_start, h_start, h_total) placing this shard's batch/head
+    range in global coordinates so the realized mask is sharding-invariant;
+    defaults to the unsharded identity.
     """
     b, s, h, d = q.shape
     if interpret is None:
         interpret = _should_interpret()
     if kv_mask is None:
         kv_mask = jnp.ones((b, s), jnp.int32)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash_attention: dropout_rate > 0 needs a "
+                         "dropout_seed (int32 scalar)")
+    b_start, h_start, h_total = (bh_offsets if bh_offsets is not None
+                                 else (0, 0, h))
+    seed = jnp.stack([
+        jnp.asarray(dropout_seed if dropout_seed is not None else 0,
+                    jnp.int32),
+        jnp.asarray(b_start, jnp.int32), jnp.asarray(h_start, jnp.int32),
+        jnp.asarray(h, jnp.int32), jnp.asarray(h_total, jnp.int32)])
     # Non-power-of-two S (ViT's 197, odd packed corpora): pad S to a lane
     # multiple so the block search can't degenerate (see _block). Padded
     # keys are masked out (zero attention weight everywhere, including the
@@ -362,27 +445,36 @@ def flash_attention(q, k, v, kv_mask=None, *, block_q: int = 512,
     def to_bh(x):  # (B, S, H, D) -> (B*H, S, D)
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), kv_mask,
-                 d ** -0.5, block_q, block_k, interpret, causal)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), kv_mask, seed,
+                 d ** -0.5, block_q, block_k, interpret, causal,
+                 float(dropout_rate))
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)[:, :s_orig]
 
 
 def flash_attention_sharded(q, k, v, kv_mask=None, *,
                             batch_axes=("data", "fsdp"),
-                            head_axis: str = "model", **kw):
+                            head_axis: str = "model",
+                            dropout_rate: float = 0.0, dropout_seed=None,
+                            **kw):
     """GSPMD-embeddable flash attention: Pallas calls don't partition under
     jit's sharding propagation, so inside a sharded program the kernel must
     run per-shard via shard_map — batch over the DP axes, heads over
     ``model``, sequence local (for a sharded sequence use ring attention).
 
     Falls through to the plain kernel when no mesh context is active
-    (single-device apply/tests).
+    (single-device apply/tests). Dropout: each shard offsets its (batch,
+    head) hash coordinates by its mesh position, so the realized mask is
+    the same one the unsharded call produces — dp/tp sharding cannot change
+    training semantics.
     """
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
-        return flash_attention(q, k, v, kv_mask, **kw)
+        return flash_attention(q, k, v, kv_mask,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed, **kw)
     if mesh.shape.get("seq", 1) > 1:
         raise ValueError(
             "flash attention keeps the full sequence on every device and "
@@ -391,10 +483,28 @@ def flash_attention_sharded(q, k, v, kv_mask=None, *,
     qkv_spec = P(batch_axes, None, head_axis, None)
     if kv_mask is None:
         kv_mask = jnp.ones(q.shape[:2], jnp.int32)
-    fn = functools.partial(flash_attention, **kw)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash_attention_sharded: dropout_rate > 0 needs "
+                         "a dropout_seed")
+    seed_arr = jnp.reshape(
+        jnp.asarray(dropout_seed if dropout_seed is not None else 0,
+                    jnp.int32), (1,))
+
+    def fn(qs, ks, vs, ms, seed1):
+        b_l, _, h_l, _ = qs.shape
+        b_idx = jnp.int32(0)
+        for ax in batch_axes:
+            b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
+        h_total = h_l * lax.axis_size(head_axis)
+        offs = (b_idx * b_l, lax.axis_index(head_axis) * h_l, h_total)
+        return flash_attention(qs, ks, vs, ms,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=seed1[0], bh_offsets=offs, **kw)
+
     # check_vma=False: pallas_call's out_shape carries no varying-axes info;
     # the body is pure per-shard compute (no collectives), so the check adds
     # nothing here.
     return jax.shard_map(
-        fn, in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axes, None)),
-        out_specs=qkv_spec, check_vma=False)(q, k, v, kv_mask)
+        fn, in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axes, None),
+                      P(None)),
+        out_specs=qkv_spec, check_vma=False)(q, k, v, kv_mask, seed_arr)

@@ -35,12 +35,21 @@ from distributeddeeplearning_tpu.ops.masks import block_causal_mask
 _NEG = -1e30
 
 
-def _block_update(q, k, v, kv_mask, m, l, acc, scale, tri=None):
+def _block_update(q, k, v, kv_mask, m, l, acc, scale, tri=None, drop=None):
     """One online-softmax accumulation step against a K/V block.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); kv_mask: (B, Sk) True=attend.
     ``tri``: optional (Sq, Sk) bool causal mask for this block pair.
     Running state m, l: (B, H, Sq); acc: (B, H, Sq, D), all float32.
+
+    ``drop``: optional attention-probability dropout as
+    (rate, seed, b0, h0, h_total, q0, k0) — rate static, the rest traced
+    scalars placing this block in GLOBAL (batch·head, query, key)
+    coordinates. The mask is the counter-based hash of those coordinates
+    (ops/hash_dropout.py), so every ring step, every shard, and every other
+    attention impl realizes the identical mask for the same seed. ``l``
+    accumulates undropped p (dense semantics: normalize, then drop);
+    backward is plain autodiff through this function, hence consistent.
     """
     keep = jnp.broadcast_to(kv_mask[:, None, None, :],
                             (q.shape[0], 1, q.shape[1], k.shape[1]))
@@ -56,13 +65,27 @@ def _block_update(q, k, v, kv_mask, m, l, acc, scale, tri=None):
     p = jnp.where(keep, p, 0.0)
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
+    if drop is not None and drop[0] > 0.0:
+        from distributeddeeplearning_tpu.ops.hash_dropout import keep_mask
+
+        rate, seed, b0, h0, h_tot, q0, k0 = drop
+        nb, sq, nh = q.shape[0], q.shape[1], q.shape[2]
+        sk = k.shape[1]
+        bh = ((b0 + jnp.arange(nb))[:, None] * h_tot
+              + h0 + jnp.arange(nh)[None, :])                # (B, H)
+        rows = q0 + jnp.arange(sq)
+        cols = k0 + jnp.arange(sk)
+        km = keep_mask(seed, bh[:, :, None, None],
+                       rows[None, None, :, None],
+                       cols[None, None, None, :], rate)
+        p = jnp.where(km, p * (1.0 / (1.0 - rate)), 0.0)
     acc_new = acc * corr[..., None] + jnp.einsum(
         "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
     return m_new, l_new, acc_new
 
 
 def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
-                   causal: bool = False):
+                   causal: bool = False, dropout=None):
     """Exact attention over a ring of sequence shards (optionally causal).
 
     Call under ``shard_map`` with the sequence dim sharded on ``axis_name``.
@@ -88,14 +111,23 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
     kv_mask = kv_mask.astype(jnp.bool_)
-    idx = lax.axis_index(axis_name) if causal else None
+    idx = (lax.axis_index(axis_name) if causal or dropout is not None
+           else None)
+
+    def blk_drop(src):
+        # Contiguous sharding: shard i holds natural positions
+        # [i*sq, (i+1)*sq) — the dropout hash coordinates stay global.
+        if dropout is None:
+            return None
+        return (*dropout, idx * sq, src * sq)
 
     # Local block first, outside the loop: it both seeds the carry with the
     # right varying-axes type (the NEG/zero inits are unvarying constants,
     # which shard_map's loop typing rejects as a carry) and leaves exactly
     # n-1 permutes in the ring.
     tri = block_causal_mask(idx, idx, sq, sq) if causal else None
-    m, l, acc = _block_update(q, k, v, kv_mask, m, l, acc, scale, tri)
+    m, l, acc = _block_update(q, k, v, kv_mask, m, l, acc, scale, tri,
+                              blk_drop(idx))
     if n > 1:
         perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -104,12 +136,13 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
             # Rotate K/V (and their padding mask) one ICI neighbour along
             # the ring, then fold the arriving block into the running state.
             k, v, msk = lax.ppermute((k, v, msk), axis_name, perm)
+            src = (idx - r) % n if idx is not None else None
             if causal:
-                src = (idx - r) % n
 
                 def fold(state):
                     tri = block_causal_mask(idx, src, sq, sq)
-                    return _block_update(q, k, v, msk, *state, scale, tri)
+                    return _block_update(q, k, v, msk, *state, scale, tri,
+                                         blk_drop(src))
 
                 # src > idx means every arriving key is in this shard's
                 # future: the whole block is masked and contributes nothing.
@@ -120,7 +153,7 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
                                      lambda state: state, fold, (m, l, acc))
             else:
                 m, l, acc = _block_update(q, k, v, msk, m, l, acc, scale,
-                                          None)
+                                          None, blk_drop(src))
             return m, l, acc, k, v, msk
 
         m, l, acc, *_ = lax.fori_loop(
@@ -136,7 +169,8 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
                            batch_axes=("data", "fsdp"),
                            head_axis: str = "model",
                            causal: bool = False,
-                           zigzag: bool = False):
+                           zigzag: bool = False,
+                           dropout_rate: float = 0.0, dropout_seed=None):
     """GSPMD-embeddable wrapper: shard_map over (batch, seq, heads).
 
     Takes *global* (B, S, H, D) arrays inside a jit-traced program (ambient
@@ -146,15 +180,25 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
     the sequence ring. ``zigzag=True`` (implies causal) maps
     :func:`zigzag_ring_attention` instead — inputs/outputs must already be
     in zigzag layout (:func:`zigzag_indices`).
+
+    ``dropout_rate`` > 0: attention-probability dropout via the global
+    counter-based hash mask (ops/hash_dropout.py) — each shard offsets its
+    coordinates by its mesh position, so the realized mask equals the dense
+    impl's at any dp x tp x sp sharding.
     """
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("ring_attention_sharded: dropout_rate > 0 needs "
+                         "a dropout_seed")
     if mesh is None:
         ambient = jax.sharding.get_abstract_mesh()
         if ambient is None or ambient.empty:
             # No mesh context (single-device apply / notebook use): one local
             # block is the whole ring. Zigzag over one shard with identity
             # permutation is plain causal attention.
+            drop = ((float(dropout_rate), dropout_seed, 0, 0, q.shape[2])
+                    if dropout_rate > 0.0 else None)
             return _local_attention(q, k, v, kv_mask,
-                                    causal=causal or zigzag)
+                                    causal=causal or zigzag, dropout=drop)
         mesh_shape = ambient.shape
     else:
         mesh_shape = mesh.shape
@@ -165,15 +209,31 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
         zigzag, causal = False, True
     qkv_spec = P(batch_axes, seq_axis, head_axis, None)
     mask_spec = P(batch_axes, seq_axis)
-    fn = (functools.partial(zigzag_ring_attention, axis_name=seq_axis)
-          if zigzag else
-          functools.partial(ring_attention, axis_name=seq_axis,
-                            causal=causal))
+    seed_arr = jnp.reshape(
+        jnp.asarray(dropout_seed if dropout_seed is not None else 0,
+                    jnp.int32), (1,))
+
+    def fn(qs, ks, vs, ms, seed1):
+        drop = None
+        if dropout_rate > 0.0:
+            b_l, h_l = qs.shape[0], qs.shape[2]
+            b_idx = jnp.int32(0)
+            for ax in batch_axes:
+                b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
+            drop = (float(dropout_rate), seed1[0], b_idx * b_l,
+                    lax.axis_index(head_axis) * h_l,
+                    h_l * lax.axis_size(head_axis))
+        if zigzag:
+            return zigzag_ring_attention(qs, ks, vs, ms,
+                                         axis_name=seq_axis, dropout=drop)
+        return ring_attention(qs, ks, vs, ms, axis_name=seq_axis,
+                              causal=causal, dropout=drop)
+
     mapped = jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, P(None)),
         out_specs=qkv_spec)
-    return mapped(q, k, v, kv_mask)
+    return mapped(q, k, v, kv_mask, seed_arr)
 
 
 # ---------------------------------------------------------------------------
@@ -226,7 +286,8 @@ def _zigzag_pairs(i: int, src: int, n: int):
     return pairs
 
 
-def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
+def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
+                          dropout=None):
     """Causal ring attention over zigzag-sharded sequences.
 
     Call under ``shard_map`` with inputs already in zigzag layout
@@ -255,7 +316,12 @@ def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq"):
 
     def fold(state, qh, qc, kh, kc, msk, tri: bool):
         mask = block_causal_mask(qc, kc, c, c) if tri else None
-        return _block_update(qh, kh[0], kh[1], msk, *state, scale, mask)
+        # Zigzag chunk qc holds NATURAL positions [qc*c, (qc+1)*c): keying
+        # the dropout hash by them makes the permuted-layout mask equal the
+        # dense impl's natural-order mask element for element.
+        drop = (*dropout, qc * c, kc * c) if dropout is not None else None
+        return _block_update(qh, kh[0], kh[1], msk, *state, scale, mask,
+                             drop)
 
     # Local arrival (src == idx): seeds the carries with varying-type values
     # (see the non-zigzag ring above) and leaves n-1 permutes in the ring.
@@ -312,7 +378,8 @@ def zigzag_ring_attention_sharded(q, k, v, kv_mask, **kw):
                                   zigzag=True, **kw)
 
 
-def _local_attention(q, k, v, kv_mask, *, causal: bool = False):
+def _local_attention(q, k, v, kv_mask, *, causal: bool = False,
+                     dropout=None):
     """The ring's single-block case without a mesh: one _block_update pass
     (still exact, still O(S) memory in scores per block — here S is global)."""
     b, sq, h, d = q.shape
@@ -320,7 +387,8 @@ def _local_attention(q, k, v, kv_mask, *, causal: bool = False):
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
     tri = block_causal_mask(0, 0, sq, sq) if causal else None
+    drop = (*dropout, 0, 0) if dropout is not None else None
     m, l, acc = _block_update(q, k, v, kv_mask.astype(jnp.bool_), m, l, acc,
-                              d ** -0.5, tri)
+                              d ** -0.5, tri, drop)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -564,8 +564,26 @@ class _EvaluatorBase:
             # EMA exists); training params continue unaffected.
             state = state.replace(params=state.ema_params)
         source, offset = self._source_and_offset()
-        outs = (jax.device_get(self.eval_step(state, source.batch(offset + j)))
-                for j in range(self.num_batches))
+        outs = []
+        for j in range(self.num_batches):
+            try:
+                batch = source.batch(offset + j)
+            except StopIteration:
+                # A real validation split is finite; a short one must yield
+                # a result over what exists, not a crash mid-training.
+                if not outs:
+                    raise RuntimeError(
+                        f"validation split yielded no full batch (global "
+                        f"batch {self._config.global_batch_size}); shrink "
+                        f"the batch or provide more validation images")
+                import warnings
+
+                warnings.warn(
+                    f"validation split exhausted after {j} of "
+                    f"{self.num_batches} eval batches; scoring the "
+                    f"available ones")
+                break
+            outs.append(jax.device_get(self.eval_step(state, batch)))
         return self._accumulate(outs)
 
 

@@ -154,6 +154,19 @@ def test_train_end_to_end_real_data(tfrecord_dir):
     assert 0.0 <= summary["eval_top1"] <= 1.0
 
 
+def test_eval_survives_short_validation_split(tfrecord_dir):
+    """A val split smaller than eval_batches x batch must score the
+    batches that exist (with a warning), not crash mid-training with a
+    StopIteration — found driving tools/real_data_on_chip.py."""
+    from distributeddeeplearning_tpu.train import loop
+
+    cfg = _cfg(tfrecord_dir, batch=16, dp=2).replace(log_every=10**9)
+    # validation split holds 2 imgs x 4 classes x 2 shards = 16 = ONE batch.
+    with pytest.warns(UserWarning, match="exhausted after 1 of 5"):
+        summary = loop.run(cfg, total_steps=2, eval_batches=5)
+    assert 0.0 <= summary["eval_top1"] <= 1.0
+
+
 def test_dispatcher_routes(tfrecord_dir):
     cfg = _cfg(tfrecord_dir)
     mesh = meshlib.make_mesh(cfg.parallel)

@@ -90,4 +90,11 @@ note decode
 timeout 600 python tools/validate_flash_tpu.py \
   > "$RES/flash_validate.json" 2>> "$RES/log.txt"
 note flash
+
+# 9. Real-pixels end-to-end: disk JPEGs -> decode -> HBM -> train -> eval
+# -> mid-run resume, through all three loaders (corpus pre-generated under
+# .cache/real_jpegs — never spend window time on PIL).
+timeout 1500 python tools/real_data_on_chip.py --steps 100 \
+  > "$RES/real_data.json" 2>> "$RES/log.txt"
+note real_data
 echo "[$(stamp)] window done" >> "$RES/log.txt"

@@ -467,7 +467,6 @@ def flash_attention_sharded(q, k, v, kv_mask=None, *,
     the same one the unsharded call produces — dp/tp sharding cannot change
     training semantics.
     """
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     mesh = jax.sharding.get_abstract_mesh()
@@ -491,12 +490,11 @@ def flash_attention_sharded(q, k, v, kv_mask=None, *,
                     jnp.int32), (1,))
 
     def fn(qs, ks, vs, ms, seed1):
-        b_l, _, h_l, _ = qs.shape
-        b_idx = jnp.int32(0)
-        for ax in batch_axes:
-            b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
-        h_total = h_l * lax.axis_size(head_axis)
-        offs = (b_idx * b_l, lax.axis_index(head_axis) * h_l, h_total)
+        from distributeddeeplearning_tpu.ops.hash_dropout import (
+            shard_bh_offsets)
+
+        offs = shard_bh_offsets(batch_axes, head_axis, qs.shape[0],
+                                qs.shape[2])
         return flash_attention(qs, ks, vs, ms,
                                dropout_rate=dropout_rate,
                                dropout_seed=seed1[0], bh_offsets=offs, **kw)

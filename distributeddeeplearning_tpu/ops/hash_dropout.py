@@ -74,6 +74,21 @@ def dense_keep_mask(seed, b: int, h: int, s_q: int, s_k: int, rate: float):
     return keep_mask(seed, bh, rows, cols, rate)
 
 
+def shard_bh_offsets(batch_axes, head_axis: str, b_local: int,
+                     h_local: int):
+    """(b_start, h_start, h_total) placing this shard's (batch, head) range
+    in GLOBAL coordinates — call inside shard_map. The ONE combine order
+    for every sharded attention wrapper: the cross-impl mask-parity
+    contract breaks silently if two wrappers ever disagree on it."""
+    from jax import lax
+
+    b_idx = jnp.int32(0)
+    for ax in batch_axes:
+        b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
+    return (b_idx * b_local, lax.axis_index(head_axis) * h_local,
+            h_local * lax.axis_size(head_axis))
+
+
 def seed_from_key(key):
     """Fold a JAX PRNG key into the int32 scalar the kernels take (SMEM on
     TPU wants int32; the hash bitcasts back to uint32)."""

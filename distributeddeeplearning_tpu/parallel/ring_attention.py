@@ -216,13 +216,12 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
     def fn(qs, ks, vs, ms, seed1):
         drop = None
         if dropout_rate > 0.0:
-            b_l, h_l = qs.shape[0], qs.shape[2]
-            b_idx = jnp.int32(0)
-            for ax in batch_axes:
-                b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
-            drop = (float(dropout_rate), seed1[0], b_idx * b_l,
-                    lax.axis_index(head_axis) * h_l,
-                    h_l * lax.axis_size(head_axis))
+            from distributeddeeplearning_tpu.ops.hash_dropout import (
+                shard_bh_offsets)
+
+            b0, h0, h_tot = shard_bh_offsets(batch_axes, head_axis,
+                                             qs.shape[0], qs.shape[2])
+            drop = (float(dropout_rate), seed1[0], b0, h0, h_tot)
         if zigzag:
             return zigzag_ring_attention(qs, ks, vs, ms,
                                          axis_name=seq_axis, dropout=drop)

@@ -569,6 +569,22 @@ class _EvaluatorBase:
             try:
                 batch = source.batch(offset + j)
             except StopIteration:
+                batch = None
+            # Multi-process: the exhaustion decision must be GLOBAL — eval
+            # steps are cross-process collectives, so one process breaking
+            # while another proceeds would deadlock the job. Every process
+            # reaches this agreement point each iteration; if ANY shard ran
+            # dry (imagefolder files rarely divide evenly), all stop here
+            # and the fetched batches of the others are discarded.
+            if jax.process_count() > 1:
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                have = multihost_utils.process_allgather(
+                    np.asarray([batch is not None], np.int32))
+                if not have.all():
+                    batch = None
+            if batch is None:
                 # A real validation split is finite; a short one must yield
                 # a result over what exists, not a crash mid-training.
                 if not outs:

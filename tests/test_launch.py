@@ -111,6 +111,69 @@ def test_fault_injection_then_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_checkpoint_save_restore_elastic(tmp_path):
+    """SURVEY §5.4 under a REAL 2-process jax.distributed job (VERDICT r3
+    Next #7 — the one checkpoint path that was only single-process-tested):
+
+    1. two processes train and SAVE (every process writes its own orbax
+       shards; the stream-meta agreement runs its collective fingerprint
+       compare at process_count=2);
+    2. the same 2-process topology RESUMES from that checkpoint;
+    3. a single process resumes the 2-process checkpoint (process-count
+       change — the elastic-restore claim, now proven against shards
+       written by a genuinely multi-process save).
+
+    Steps stay tiny: the XLA:CPU in-process collective watchdog aborts
+    long dp>1 runs on this box (documented in conftest notes).
+    """
+    import json
+
+    ckpt = str(tmp_path / "ckpt")
+
+    def train_cmd(steps: int, dp: int) -> list:
+        return [sys.executable, "train.py", "--backend", "cpu", "--model",
+                "resnet18", "--batch-size", "8", "--dp", str(dp),
+                "--synthetic", "--dtype", "float32", "--steps", str(steps),
+                "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+                "--log-every", "1000000"]
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["XLA_FLAGS"] = ""  # 1 CPU device per process -> dp=2 spans procs
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run2(steps: int):
+        return subprocess.run(
+            [sys.executable, "launch.py", "--num-processes", "2", "--"]
+            + train_cmd(steps, dp=2),
+            capture_output=True, text=True, timeout=900, env=env)
+
+    def summary_of(proc):
+        lines = [ln for ln in proc.stdout.splitlines() if "summary" in ln]
+        assert lines, (proc.returncode, proc.stderr[-2000:])
+        return json.loads(lines[-1])["summary"]
+
+    first = run2(4)
+    assert first.returncode == 0, first.stderr[-2000:]
+    s1 = summary_of(first)
+    assert s1["start_step"] == 0 and s1["final_step"] == 4
+
+    second = run2(6)
+    assert second.returncode == 0, second.stderr[-2000:]
+    s2 = summary_of(second)
+    assert s2["start_step"] == 4, s2  # resumed the multi-process save
+    assert s2["final_step"] == 6
+
+    # Elastic: one process, one device, restores the 2-process shards.
+    solo = subprocess.run(train_cmd(8, dp=1), capture_output=True,
+                          text=True, timeout=600, env=env)
+    assert solo.returncode == 0, solo.stderr[-2000:]
+    s3 = summary_of(solo)
+    assert s3["start_step"] == 6, s3
+    assert s3["final_step"] == 8
+
+
+@pytest.mark.slow
 def test_max_restarts_auto_resumes(tmp_path):
     """--max-restarts closes the §5.3 loop in-launcher: the injected crash
     triggers an automatic relaunch that resumes from the checkpoint and

@@ -94,7 +94,8 @@ note flash
 # 9. Real-pixels end-to-end: disk JPEGs -> decode -> HBM -> train -> eval
 # -> mid-run resume, through all three loaders (corpus pre-generated under
 # .cache/real_jpegs — never spend window time on PIL).
+# 7 legs x 180s fits the outer budget with slack for corpus checks.
 timeout 1500 python tools/real_data_on_chip.py --steps 100 \
-  > "$RES/real_data.json" 2>> "$RES/log.txt"
+  --leg-timeout 180 > "$RES/real_data.json" 2>> "$RES/log.txt"
 note real_data
 echo "[$(stamp)] window done" >> "$RES/log.txt"

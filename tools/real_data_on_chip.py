@@ -111,6 +111,11 @@ def main(argv=None) -> int:
     p.add_argument("--keep-checkpoints", action="store_true")
     args = p.parse_args(argv)
 
+    # Size-keyed subdirectory: different --images/--image-size runs must
+    # never share split dirs (a smoke run would otherwise overwrite part of
+    # a larger corpus and every later run would train on a mixed one).
+    args.data_dir = os.path.join(args.data_dir,
+                                 f"{args.images}x{args.image_size}")
     ensure_corpus(args.data_dir, args.images, args.image_size)
     ckroot = tempfile.mkdtemp(prefix="realdata_ck_")
     base = [sys.executable, os.path.join(REPO, "train.py"),

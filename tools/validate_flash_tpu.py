@@ -119,7 +119,35 @@ def main() -> int:
     print(json.dumps({"check": "causal_forward", "max_abs_err": causal_err,
                       "ok": ok_causal}), flush=True)
 
+    # In-kernel hash dropout (round-4 semantics closure): the COMPILED
+    # Mosaic lowering of the uint32 mixer must (a) exist, (b) agree with
+    # the jnp-built mask (the oracle the CPU suite pins all impls to —
+    # agreement here closes the chain compiled==jnp==interpret), and
+    # (c) cost little (5 VPU ops per element; timing printed below).
+    from distributeddeeplearning_tpu.ops.hash_dropout import dense_keep_mask
+    RATE, SEED = 0.1, jnp.int32(20260731)
+    flash_do = jax.jit(functools.partial(
+        flash_attention, interpret=False, dropout_rate=RATE,
+        dropout_seed=SEED))
+    out_do = np.asarray(flash_do(q, k, v, mask), np.float32)
+    km = np.asarray(dense_keep_mask(SEED, B, H, S, S, RATE))
+    p_ref = jax.nn.softmax(jnp.where(
+        jnp.asarray(np.asarray(mask))[:, None, None, :],
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (D ** -0.5),
+        jnp.finfo(jnp.float32).min), axis=-1)
+    p_ref = jnp.where(jnp.asarray(km), p_ref / (1 - RATE), 0.0)
+    out_do_ref = np.asarray(jnp.einsum(
+        "bhqk,bkhd->bqhd", p_ref, v.astype(jnp.float32)), np.float32)
+    do_err = float(np.abs((out_do - out_do_ref) * valid).max())
+    ok_dropout = do_err < 2e-2
+    print(json.dumps({"check": "dropout_forward_compiled_vs_hash_ref",
+                      "max_abs_err": do_err, "ok": ok_dropout,
+                      "dropped_frac_ref": round(1.0 - float(km.mean()), 4)}),
+          flush=True)
+
     t_flash = timed(flash, q, k, v, mask)
+    t_flash_do = timed(flash_do, q, k, v, mask)
     t_dense = timed(dense, q, k, v, mask)
     grad_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
     grad_d = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
@@ -128,11 +156,12 @@ def main() -> int:
     print(json.dumps({
         "check": "timing", "shape": [B, S, H, D],
         "fwd_ms": {"flash": round(t_flash * 1e3, 3),
+                   "flash_dropout": round(t_flash_do * 1e3, 3),
                    "dense": round(t_dense * 1e3, 3)},
         "fwd_bwd_ms": {"flash": round(t_flash_bwd * 1e3, 3),
                        "dense": round(t_dense_bwd * 1e3, 3)},
     }), flush=True)
-    return 0 if (ok_fwd and ok_bwd and ok_causal) else 1
+    return 0 if (ok_fwd and ok_bwd and ok_causal and ok_dropout) else 1
 
 
 if __name__ == "__main__":

@@ -42,6 +42,36 @@ def _args(bench, extra=()):
     return ns["args"]
 
 
+class FakeJax:
+    """Module-level jax stub for _child tests (the real jax import would
+    bind the axon platform); one copy so new jax attribute accesses in
+    _child get added here exactly once."""
+
+    @staticmethod
+    def device_count():
+        return 1
+
+    @staticmethod
+    def devices():
+        class D:
+            platform = "cpu"
+        return [D()]
+
+    class config:
+        @staticmethod
+        def update(*a):
+            pass
+
+
+def _run_child_with_fake_jax(bench, args):
+    sys.modules.setdefault("jax", FakeJax)
+    try:
+        return bench._child(args)
+    finally:
+        if sys.modules.get("jax") is FakeJax:
+            del sys.modules["jax"]
+
+
 def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     seen = []
 
@@ -60,28 +90,7 @@ def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
                          "--suite-models",
                          "resnet50,densenet121,bert_base"])
 
-    class FakeJax:
-        @staticmethod
-        def device_count():
-            return 1
-
-        @staticmethod
-        def devices():
-            class D:
-                platform = "cpu"
-            return [D()]
-
-        class config:
-            @staticmethod
-            def update(*a):
-                pass
-
-    sys.modules.setdefault("jax", FakeJax)  # _child imports jax
-    try:
-        rc = bench._child(args)
-    finally:
-        if sys.modules.get("jax") is FakeJax:
-            del sys.modules["jax"]
+    rc = _run_child_with_fake_jax(bench, args)
     assert rc == 0
     models = [s[0] for s in seen]
     assert models == ["resnet50", "densenet121", "bert_base", "bert_base",
@@ -106,34 +115,40 @@ def test_sweep_emits_only_if_faster(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_child_measure", fake_measure)
     args = _args(bench, ["--model", "resnet50", "--sweep", "256,128"])
 
-    class FakeJax:
-        @staticmethod
-        def device_count():
-            return 1
-
-        @staticmethod
-        def devices():
-            class D:
-                platform = "cpu"
-            return [D()]
-
-        class config:
-            @staticmethod
-            def update(*a):
-                pass
-
-    sys.modules.setdefault("jax", FakeJax)
-    try:
-        bench._child(args)
-    finally:
-        if sys.modules.get("jax") is FakeJax:
-            del sys.modules["jax"]
+    _run_child_with_fake_jax(bench, args)
     out = [json.loads(line) for line in
            capsys.readouterr().out.strip().splitlines()]
     # Primary (100) emitted; b256 (90) silent; b128 (120) emitted.
     values = [r["value"] for r in out]
     assert values == [100.0, 120.0]
     assert "sweep" in out[-1]["protocol"]
+
+
+def test_fused_block_alternate_emits_only_if_faster(bench, monkeypatch,
+                                                    capsys):
+    """The headline run measures the conv-epilogue-fusion variant at the
+    winning batch and emits it only on a strict win (same last-line-wins
+    discipline as the batch sweep)."""
+    for fused_rate, expect_emitted in ((130.0, True), (80.0, False)):
+        rates = {(512, False): 100.0, (256, False): 90.0,
+                 (512, True): fused_rate}
+
+        def fake_measure(row, emit_quick=True, emit_final=True):
+            rate = rates[(row.batch_size, row.fused_block)]
+            if emit_final:
+                bench._emit_metric(row, rate, protocol=f"b{row.batch_size}")
+            return rate
+
+        monkeypatch.setattr(bench, "_child_measure", fake_measure)
+        args = _args(bench, ["--model", "resnet50"])  # sweep stays "auto"
+
+        _run_child_with_fake_jax(bench, args)
+        out = [json.loads(line) for line in
+               capsys.readouterr().out.strip().splitlines()]
+        fused = [r for r in out if "fusedblock" in (r.get("protocol") or "")]
+        assert bool(fused) == expect_emitted, (fused_rate, out)
+        if expect_emitted:
+            assert out[-1]["value"] == fused_rate  # last line wins
 
 
 def test_preflight_kills_hung_backend_fast(bench):

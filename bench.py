@@ -62,19 +62,31 @@ BACKEND_UP_HEARTBEAT = "backend up:"
 COMPILE_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".cache", "jax_compile")
 
-# --suite rows: (model, overrides). Batch sizes are the measured sweet spots
-# from BASELINE.md's round-2 sweeps; S=2048 rows need flash+remat to fit.
+# --suite rows: (model, overrides, est_s) in VALUE-PER-MINUTE order — a
+# window that dies mid-suite yields the most valuable prefix (VERDICT r4
+# Weak #5). est_s is the expected on-chip wall cost of the row (compile
+# with warm persistent cache + measure; round-2/3 sessions measured
+# ~30-60s compile + ~60s measure per row) and gates row admission against
+# the remaining --suite-budget; it is NOT a hard per-row kill (the row
+# deadline handles that). Batch sizes are the measured sweet spots from
+# BASELINE.md's round-2 sweeps; S=2048 rows need flash+remat to fit.
 SUITE = (
-    ("resnet50", {}),
-    ("resnet152", {"batch_size": 256}),
-    ("densenet121", {"batch_size": 256}),
-    ("vit_b16", {"batch_size": 256}),
-    ("bert_base", {"batch_size": 32, "seq_len": 512}),
+    # Headline family first: its compile cache is warm from the headline
+    # run, and the acceptance metric of record is this row.
+    ("resnet50", {}, 90),
+    # Never measured on chip under the gather-head protocol (r2 protocol
+    # change) — the two highest-value unknown rows.
     ("bert_base", {"batch_size": 32, "seq_len": 512,
-                   "attention_impl": "flash"}),
+                   "attention_impl": "flash"}, 120),
+    ("gpt2_small", {"batch_size": 16, "seq_len": 1024}, 120),
+    ("bert_base", {"batch_size": 32, "seq_len": 512}, 120),
+    ("resnet152", {"batch_size": 256}, 120),
+    ("densenet121", {"batch_size": 256}, 120),
+    ("vit_b16", {"batch_size": 256}, 120),
+    # Long-context last: largest compile, slowest steps, and its CPU-side
+    # evidence (flash==dense parity) is the strongest of the set.
     ("bert_base", {"batch_size": 32, "seq_len": 2048,
-                   "attention_impl": "flash", "remat": True}),
-    ("gpt2_small", {"batch_size": 16, "seq_len": 1024}),
+                   "attention_impl": "flash", "remat": True}, 180),
 )
 
 
@@ -126,12 +138,43 @@ def _protocol_suffix(args) -> str:
     return (" " + "+".join(parts)) if parts else ""
 
 
+def _mfu_fields(args, value: float) -> dict:
+    """tflops_per_sec + mfu_pct for a rate of ``value`` examples/sec/chip
+    (VERDICT r4 Next #5). Model FLOPs are the analytic fwd+bwd enumeration
+    (models/flops.py, 2-flops-per-MAC convention, validated against XLA
+    cost analysis by tests/test_flops.py); the peak is the detected chip's
+    bf16 spec number. Never raises — an unknown model or backend simply
+    omits the fields, because a missing efficiency annotation must not
+    cost a throughput measurement."""
+    try:
+        from distributeddeeplearning_tpu.config import (
+            resolve_mlm_max_predictions)
+        from distributeddeeplearning_tpu.models import flops as flopslib
+        from distributeddeeplearning_tpu.models import model_spec
+        spec = model_spec(args.model)
+        mlm_pred = (resolve_mlm_max_predictions(
+            args.mlm_max_predictions, args.seq_len, spec.objective)
+            if spec.input_kind == "tokens" else 0)
+        per_ex = flopslib.train_flops_per_example(
+            args.model, seq_len=args.seq_len, mlm_positions=mlm_pred)
+        if per_ex is None:
+            return {}
+        out = {"tflops_per_sec": round(value * per_ex / 1e12, 2)}
+        import jax
+        peak = flopslib.bf16_peak_flops(jax.devices()[0].device_kind)
+        if peak:
+            out["mfu_pct"] = round(100.0 * value * per_ex / peak, 1)
+        return out
+    except Exception:
+        return {}
+
+
 def _emit_metric(args, value: float, protocol: str) -> None:
     metric, unit = _metric_name_unit(args)
     # The 1450 img/s denominator is specifically the V100 ResNet50 AMP
     # figure — comparing any other model against it would be meaningless,
     # so vs_baseline is emitted only for the metric of record.
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
@@ -139,7 +182,14 @@ def _emit_metric(args, value: float, protocol: str) -> None:
                         if args.model == "resnet50" else None),
         "protocol": protocol + _protocol_suffix(args),
         "baseline_denominator": BASELINE_DENOMINATOR_NOTE,
-    }), flush=True)
+    }
+    rec.update(_mfu_fields(args, value))
+    # Structured kernel-config marker (ADVICE r4 bench.py:303): consumers
+    # of the last-good table can filter fused-kernel records without
+    # parsing the protocol string.
+    if getattr(args, "fused_block", False):
+        rec["fused_block"] = True
+    print(json.dumps(rec), flush=True)
 
 
 def _note(msg: str) -> None:
@@ -148,7 +198,8 @@ def _note(msg: str) -> None:
 
 
 def _child_measure(args, emit_quick: bool = True,
-                   emit_final: bool = True) -> float:
+                   emit_final: bool = True,
+                   deadline: float | None = None) -> float:
     """One config: compile once, emit quick then full-protocol lines;
     returns the full-protocol rate.
 
@@ -156,7 +207,15 @@ def _child_measure(args, emit_quick: bool = True,
     so each config contributes exactly one metric line. ``emit_final=False``
     (batch-sweep alternates) measures without printing — the caller emits
     only if the alternate beats the primary, because the driver takes the
-    LAST line and a slower alternate must never shadow a faster primary."""
+    LAST line and a slower alternate must never shadow a faster primary.
+
+    ``deadline`` (time.monotonic value) is the row's wall budget: the
+    timed loops stop early when it passes and the rate is computed over
+    the steps actually completed (protocol records the cut), so a suite
+    row that runs long yields a shorter valid measurement instead of
+    eating the rows behind it. Compile+warmup is never interrupted — by
+    the time the deadline can fire the expensive part is already paid. If
+    the deadline passes before ANY timed step completes, TimeoutError."""
     import jax
 
     from distributeddeeplearning_tpu import data as datalib
@@ -207,29 +266,66 @@ def _child_measure(args, emit_quick: bool = True,
     jax.device_get(metrics)
     _note(f"compile+warmup({quick_w}) done in "
           f"{time.perf_counter() - t_compile:.1f}s; quick window starts")
-    t0 = time.perf_counter()
-    for _ in range(quick_n):
-        state, metrics = train_step(state, source.batch(i), rng)
-        i += 1
-    jax.device_get(metrics)
-    elapsed = time.perf_counter() - t0
-    if emit_quick:
-        _emit_metric(args, cfg.global_batch_size * quick_n / elapsed / n_dev,
-                     protocol=f"quick w{quick_w}+{quick_n} b{args.batch_size}")
+    def timed_window(n_steps: int):
+        """Dispatch up to n_steps; returns (steps_done, elapsed).
+
+        Without a deadline: one device_get barrier at the end (steps
+        pipeline freely — the round-2/3 protocol). With a deadline: steps
+        are dispatched in chunks of 5 with a barrier + clock check between
+        chunks — async dispatch would otherwise queue the whole window in
+        milliseconds and make the deadline unenforceable. The extra
+        barriers cost one tunnel round-trip per chunk (amortized over 5
+        steps), the price of a row that can be cut on budget."""
+        nonlocal state, metrics, i
+        t0 = time.perf_counter()
+        done = 0
+        chunk = n_steps if deadline is None else 5
+        while done < n_steps:
+            for _ in range(min(chunk, n_steps - done)):
+                state, metrics = train_step(state, source.batch(i), rng)
+                i += 1
+                done += 1
+            jax.device_get(metrics)
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        return done, time.perf_counter() - t0
+
+    # Protocol marker: chunked barriers are measurement-protocol drift vs
+    # the barrier-free round-2/3 windows (one pipeline drain per 5 steps
+    # instead of one per window) — the emitted numbers must say so, or
+    # they'd overwrite prior last-good entries as silently incomparable.
+    mark = "" if deadline is None else " chunked"
+    q_done, q_elapsed = timed_window(quick_n)
+    q_rate = (cfg.global_batch_size * q_done / q_elapsed / n_dev
+              if q_done else 0.0)
+    if emit_quick and q_done:
+        _emit_metric(args, q_rate,
+                     protocol=f"quick w{quick_w}+{q_done} "
+                              f"b{args.batch_size}{mark}")
     # Full-protocol window: everything so far (quick_w + quick_n >= the
     # classic 10) counts as warmup; time a fresh window of args.steps.
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = train_step(state, source.batch(i), rng)
-        i += 1
-    jax.device_get(metrics)
-    elapsed = time.perf_counter() - t0
-    rate = cfg.global_batch_size * args.steps / elapsed / n_dev
-    if emit_final:
-        _emit_metric(args, rate,
-                     protocol=f"w{quick_w + quick_n}+{args.steps} "
-                              f"b{args.batch_size}")
-    return rate
+    if deadline is None or time.monotonic() < deadline:
+        done, elapsed = timed_window(args.steps)
+    else:
+        done = 0
+    if done:
+        rate = cfg.global_batch_size * done / elapsed / n_dev
+        cut = "" if done == args.steps else " cut"
+        if emit_final:
+            _emit_metric(args, rate,
+                         protocol=f"w{quick_w + q_done}+{done} "
+                                  f"b{args.batch_size}{mark}{cut}")
+        return rate
+    if q_done:
+        # Deadline landed inside the quick window: the quick measurement
+        # is the row's result (still post-compile, >= 1 timed step).
+        if emit_final:
+            _emit_metric(args, q_rate,
+                         protocol=f"quick w{quick_w}+{q_done} "
+                                  f"b{args.batch_size}{mark} cut")
+        return q_rate
+    raise TimeoutError(
+        f"row deadline passed before any timed step (warmup {quick_w})")
 
 
 def _sweep_batches(args) -> list[int]:
@@ -311,8 +407,21 @@ def _child(args) -> int:
         return 0
     wanted = (set(args.suite_models.split(","))
               if args.suite_models else None)
-    for model, overrides in SUITE:
+    wanted_rows = (set(int(i) for i in args.suite_rows.split(","))
+                   if args.suite_rows else None)
+    # Suite budget discipline (VERDICT r4 Weak #5): rows run in SUITE's
+    # value-per-minute order against one deadline anchored at backend-up.
+    # A row is ADMITTED only if 60% of its est_s fits in the remaining
+    # budget (a partially-measured row still emits, so starting with most
+    # of a row's budget available beats skipping it); a row that runs long
+    # is CUT by its own deadline (min(est_s * 2, suite deadline)) instead
+    # of eating the rows behind it. Skips are visible on stderr.
+    suite_deadline = (time.monotonic() + args.suite_budget
+                      if args.suite_budget > 0 else None)
+    for row_i, (model, overrides, est_s) in enumerate(SUITE):
         if wanted is not None and model not in wanted:
+            continue
+        if wanted_rows is not None and row_i not in wanted_rows:
             continue
         row = copy.copy(args)
         row.model = model
@@ -320,8 +429,18 @@ def _child(args) -> int:
         row.fused_block = False
         for k, v in overrides.items():
             setattr(row, k, v)
+        row_deadline = None
+        if suite_deadline is not None:
+            remaining = suite_deadline - time.monotonic()
+            if remaining < 0.6 * est_s:
+                _note(f"suite row {model} b{row.batch_size}"
+                      f"{_protocol_suffix(row)} SKIPPED on budget "
+                      f"(remaining {remaining:.0f}s < 0.6*est {est_s}s)")
+                continue
+            row_deadline = min(suite_deadline,
+                               time.monotonic() + 2.0 * est_s)
         try:
-            _child_measure(row, emit_quick=False)
+            _child_measure(row, emit_quick=False, deadline=row_deadline)
         except Exception as e:  # one OOM must not sink the rest of the suite
             metric, unit = _metric_name_unit(row)
             print(json.dumps({
@@ -521,8 +640,22 @@ def main(argv=None) -> int:
     p.add_argument("--suite-models", default=None,
                    help="with --suite: only measure rows whose model is "
                         "in this comma list (re-run a single row)")
+    p.add_argument("--suite-rows", default=None,
+                   help="with --suite: only measure rows at these indices "
+                        "into SUITE (comma list, 0-based, value-per-minute "
+                        "order) — unlike --suite-models this selects "
+                        "EXACT rows, e.g. one of the bert_base protocol "
+                        "variants (tools/chip_window.sh splits the suite "
+                        "across window steps with this)")
     p.add_argument("--suite", action="store_true",
                    help="measure every acceptance config, one line each")
+    p.add_argument("--suite-budget", type=int, default=-1,
+                   help="wall budget (s) for the suite rows themselves, "
+                        "anchored after backend init; rows that don't fit "
+                        "are skipped with a stderr note and a row that "
+                        "runs long is cut at 2x its estimate. -1 = derive "
+                        "from --budget minus an init margin; 0 = no "
+                        "budget (measure every row to completion)")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu) for smoke runs")
     p.add_argument("--attempt-timeout", type=int, default=480,
@@ -556,13 +689,25 @@ def main(argv=None) -> int:
         p.error("--sweep is a headline-run option; suite rows pin their "
                 "measured sweet-spot batches (see SUITE)")
     if args.suite_models:
-        known = {m for m, _ in SUITE}
+        known = {m for m, _o, _e in SUITE}
         asked = {s.strip() for s in args.suite_models.split(",") if s.strip()}
         if not asked or asked - known:
             p.error(f"--suite-models: unknown model(s) "
                     f"{sorted(asked - known) or args.suite_models!r}; "
                     f"suite rows: {sorted(known)}")
         args.suite_models = ",".join(sorted(asked))
+    if args.suite_rows:
+        if args.suite_models:
+            p.error("--suite-rows and --suite-models are mutually "
+                    "exclusive (rows select exact entries)")
+        try:
+            rows = sorted({int(i) for i in args.suite_rows.split(",")})
+        except ValueError:
+            p.error(f"--suite-rows {args.suite_rows!r}: expected a comma "
+                    f"list of ints")
+        if not rows or rows[0] < 0 or rows[-1] >= len(SUITE):
+            p.error(f"--suite-rows: indices must be in [0, {len(SUITE)-1}]")
+        args.suite_rows = ",".join(str(i) for i in rows)
 
     if args.run_child:
         return _child(args)
@@ -592,6 +737,8 @@ def main(argv=None) -> int:
         child_cmd += ["--suite"]
         if args.suite_models:
             child_cmd += ["--suite-models", args.suite_models]
+        if args.suite_rows:
+            child_cmd += ["--suite-rows", args.suite_rows]
         args.attempt_timeout = max(args.attempt_timeout, args.budget)
 
     last_err = "no attempt ran"
@@ -604,8 +751,21 @@ def main(argv=None) -> int:
         if remaining < 30:
             last_err += "; budget exhausted"
             break
+        cmd = list(child_cmd)
+        if args.suite:
+            # The child's row budget excludes backend init (its clock
+            # starts after jax.devices() returns) but must leave the
+            # parent room to relay the last row before --budget ends —
+            # derived from the budget REMAINING at this attempt, so a
+            # retry's gating matches the time it actually has (a first
+            # derivation reused verbatim would admit rows the parent's
+            # deadline then kills mid-row). Floor of 60s: a derived
+            # budget must never collapse to 0, which means "no gating".
+            suite_budget = (args.suite_budget if args.suite_budget >= 0
+                            else max(60, int(remaining) - 120))
+            cmd += ["--suite-budget", str(suite_budget)]
         n_lines, err_tail, rc = _run_attempt(
-            child_cmd, timeout=min(args.attempt_timeout, remaining),
+            cmd, timeout=min(args.attempt_timeout, remaining),
             relay_errors=args.suite, record_good=not args.platform,
             preflight=args.preflight_timeout)
         if args.suite and n_lines and rc != 0:

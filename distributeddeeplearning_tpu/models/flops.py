@@ -1,0 +1,138 @@
+"""Analytic training FLOPs per example, for MFU reporting (bench.py).
+
+Convention: a multiply-accumulate counts as 2 FLOPs — the same convention
+as both XLA's cost analysis and published chip peaks, so
+``mfu = rate * train_flops_per_example / peak`` is dimensionally honest.
+``train ≈ 3 x forward`` (backward is two matmuls per forward matmul); the
+optimizer update is O(params) — three orders of magnitude below the matmul
+term at batch>=1 — and is deliberately not counted, matching the standard
+MFU definition (model FLOPs, not executed FLOPs: remat recompute is also
+NOT counted, so a remat run's MFU honestly reports the efficiency loss).
+
+CNN entries are the conv-sum constants at 224x224 (the literature MAC
+counts x2); transformer FLOPs are enumerated exactly from each model's
+config dataclass (qkv/out/ffn matmuls + the two S^2 attention matmuls +
+the LM/MLM head). Both are validated against XLA lowered-HLO cost
+analysis on CPU by tests/test_flops.py (tools/calibrate_flops.py is the
+standalone calibration harness).
+"""
+
+from __future__ import annotations
+
+# Forward FLOPs per image at 224x224, 2 x the canonical conv+fc MAC sums
+# (torchvision geometry — enforced by the param-count tests in
+# tests/test_models.py).
+_CNN_FWD_FLOPS_224 = {
+    "resnet18": 3.64e9,
+    "resnet34": 7.34e9,
+    "resnet50": 8.18e9,
+    "resnet101": 15.6e9,
+    "resnet152": 23.0e9,
+    "densenet121": 5.74e9,
+    "densenet169": 6.81e9,
+}
+
+# bf16 systolic-array peak FLOP/s per chip, keyed by substrings of
+# ``jax.devices()[0].device_kind`` (lowercased). Sources: published TPU
+# spec sheets; v5e ("TPU v5 lite") = 197 TFLOP/s bf16.
+_BF16_PEAK_BY_KIND = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def bf16_peak_flops(device_kind: str) -> float | None:
+    """Per-chip bf16 peak for a jax device_kind, or None if unknown."""
+    kind = device_kind.lower()
+    for sub, peak in _BF16_PEAK_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _transformer_fwd_flops(*, num_layers: int, hidden: int, ffn: int,
+                           seq_len: int, vocab: int, head_positions: int,
+                           kv_heads_frac: float = 1.0,
+                           ffn_matmuls: int = 2,
+                           mlm_transform: bool = False,
+                           patch_embed_in: int = 0,
+                           num_classes: int = 0) -> float:
+    """Exact matmul enumeration for one example (2 x MAC).
+
+    ``head_positions``: rows hitting the vocab projection (S for causal /
+    dense MLM, the gather width for gather-mode MLM, 0 for classifiers).
+    ``kv_heads_frac``: num_kv_heads / num_heads (GQA shrinks the KV proj).
+    ``ffn_matmuls``: 2 for GELU MLPs, 3 for SwiGLU.
+    ``patch_embed_in``: ViT patch-embedding input dim (P*P*3), else 0.
+    """
+    s, d = seq_len, hidden
+    per_layer = (
+        2 * s * d * d            # Q proj
+        + 2 * 2 * s * d * (d * kv_heads_frac)  # K and V proj
+        + 2 * s * s * d          # scores Q @ K^T (all heads)
+        + 2 * s * s * d          # probs @ V
+        + 2 * s * d * d          # output proj
+        + ffn_matmuls * 2 * s * d * ffn)
+    head = 2 * head_positions * d * vocab
+    if mlm_transform:
+        head += 2 * head_positions * d * d
+    if num_classes:
+        head += 2 * d * num_classes
+    embed = 2 * s * patch_embed_in * d if patch_embed_in else 0.0
+    return num_layers * per_layer + head + embed
+
+
+def fwd_flops_per_example(model: str, *, seq_len: int | None = None,
+                          mlm_positions: int = 0) -> float | None:
+    """Analytic forward FLOPs for one example, or None if the model has no
+    entry (tiny/test models are deliberately absent). ``mlm_positions`` is
+    the gather-head width (0 = dense full-sequence logits)."""
+    if model in _CNN_FWD_FLOPS_224:
+        return _CNN_FWD_FLOPS_224[model]
+    if model == "vit_b16":
+        return _transformer_fwd_flops(
+            num_layers=12, hidden=768, ffn=3072, seq_len=197, vocab=0,
+            head_positions=0, patch_embed_in=16 * 16 * 3, num_classes=1000)
+    if model == "vit_l16":
+        return _transformer_fwd_flops(
+            num_layers=24, hidden=1024, ffn=4096, seq_len=197, vocab=0,
+            head_positions=0, patch_embed_in=16 * 16 * 3, num_classes=1000)
+    if seq_len is None:
+        return None
+    if model in ("bert_base", "bert_large"):
+        large = model == "bert_large"
+        return _transformer_fwd_flops(
+            num_layers=24 if large else 12, hidden=1024 if large else 768,
+            ffn=4096 if large else 3072, seq_len=seq_len, vocab=30522,
+            head_positions=mlm_positions or seq_len, mlm_transform=True)
+    if model in ("gpt2_small", "gpt2_medium"):
+        med = model == "gpt2_medium"
+        return _transformer_fwd_flops(
+            num_layers=24 if med else 12, hidden=1024 if med else 768,
+            ffn=4096 if med else 3072, seq_len=seq_len, vocab=50257,
+            head_positions=seq_len)
+    if model == "llama2_7b":
+        return _transformer_fwd_flops(
+            num_layers=32, hidden=4096, ffn=11008, seq_len=seq_len,
+            vocab=32000, head_positions=seq_len, ffn_matmuls=3)
+    if model == "tinyllama_1b":
+        return _transformer_fwd_flops(
+            num_layers=22, hidden=2048, ffn=5632, seq_len=seq_len,
+            vocab=32000, head_positions=seq_len, ffn_matmuls=3,
+            kv_heads_frac=4 / 32)
+    return None
+
+
+def train_flops_per_example(model: str, *, seq_len: int | None = None,
+                            mlm_positions: int = 0) -> float | None:
+    """fwd+bwd model FLOPs per example (3 x forward), or None."""
+    fwd = fwd_flops_per_example(model, seq_len=seq_len,
+                                mlm_positions=mlm_positions)
+    return None if fwd is None else 3.0 * fwd

@@ -75,7 +75,7 @@ def _run_child_with_fake_jax(bench, args):
 def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     seen = []
 
-    def fake_measure(row, emit_quick=True, emit_final=True):
+    def fake_measure(row, emit_quick=True, emit_final=True, deadline=None):
         seen.append((row.model, row.batch_size, row.attention_impl,
                      row.remat))
         if row.model == "densenet121":
@@ -93,7 +93,10 @@ def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     rc = _run_child_with_fake_jax(bench, args)
     assert rc == 0
     models = [s[0] for s in seen]
-    assert models == ["resnet50", "densenet121", "bert_base", "bert_base",
+    # SUITE's value-per-minute order: resnet50, bert flash, (gpt2 filtered
+    # out), bert dense, (resnet152 filtered), densenet121, (vit filtered),
+    # bert 2048.
+    assert models == ["resnet50", "bert_base", "bert_base", "densenet121",
                       "bert_base"]
     # Suite rows must NOT inherit headline flags; row overrides apply.
     assert all(s[3] is False for s in seen[:2])  # remat reset
@@ -213,6 +216,154 @@ def test_error_record_carries_stale_age(bench, capsys):
     assert rec["last_measured_on_live_chip"]["value"] == 2000.0
     # Top-level age: ~1h, with slack for slow test boxes.
     assert 3500 <= rec["stale_age_s"] <= 3800
+
+
+def test_suite_budget_skips_and_admits_rows(bench, monkeypatch, capsys):
+    """VERDICT r4 Weak #5 contract: a row whose estimate doesn't fit the
+    remaining suite budget is skipped WITH a stderr note, and cheaper rows
+    behind it are still admitted (a dying window yields the best prefix,
+    not a silent truncation)."""
+    seen = []
+
+    def fake_measure(row, emit_quick=True, emit_final=True, deadline=None):
+        seen.append((row.model, deadline))
+        print(json.dumps({"metric": f"{row.model}_x", "value": 1.0}),
+              flush=True)
+        return 1.0
+
+    monkeypatch.setattr(bench, "_child_measure", fake_measure)
+    monkeypatch.setattr(bench, "SUITE", (
+        ("resnet50", {}, 10_000),          # can't fit: skip + note
+        ("gpt2_small", {"batch_size": 16, "seq_len": 1024}, 1),  # fits
+    ))
+    args = _args(bench, ["--suite", "--suite-budget", "5"])
+
+    rc = _run_child_with_fake_jax(bench, args)
+    assert rc == 0
+    assert [s[0] for s in seen] == ["gpt2_small"]
+    # The admitted row carries a concrete per-row deadline.
+    assert seen[0][1] is not None
+    captured = capsys.readouterr()
+    assert "SKIPPED on budget" in captured.err
+    assert "resnet50" in captured.err
+    out = [json.loads(line) for line in captured.out.strip().splitlines()]
+    assert [r["metric"] for r in out] == ["gpt2_small_x"]
+
+
+def test_suite_rows_selects_exact_rows(bench, monkeypatch, capsys):
+    """--suite-rows picks SUITE entries by index — the only way to select
+    one bert_base protocol variant (tools/chip_window.sh splits the suite
+    across window steps with it)."""
+    seen = []
+
+    def fake_measure(row, emit_quick=True, emit_final=True, deadline=None):
+        seen.append((row.model, row.attention_impl, row.seq_len))
+        return 1.0
+
+    monkeypatch.setattr(bench, "_child_measure", fake_measure)
+    args = _args(bench, ["--suite", "--suite-rows", "1,7"])
+    _run_child_with_fake_jax(bench, args)
+    assert seen == [("bert_base", "flash", 512),
+                    ("bert_base", "flash", 2048)]
+
+
+def test_suite_order_contract_for_chip_window(bench):
+    """tools/chip_window.sh steps 3 and 6 hard-code --suite-rows 0,1,2,3 /
+    4,5,6,7 against this exact ordering; reorder SUITE and you must update
+    the script (and this pin)."""
+    key = [(m, o.get("attention_impl"), o.get("seq_len"))
+           for m, o, _e in bench.SUITE]
+    assert key == [
+        ("resnet50", None, None),
+        ("bert_base", "flash", 512),
+        ("gpt2_small", None, 1024),
+        ("bert_base", None, 512),
+        ("resnet152", None, None),
+        ("densenet121", None, None),
+        ("vit_b16", None, None),
+        ("bert_base", "flash", 2048),
+    ]
+
+
+def test_suite_rows_validation(bench, capsys):
+    with pytest.raises(SystemExit):
+        bench.main(["--suite", "--suite-rows", "0,99"])
+    with pytest.raises(SystemExit):
+        bench.main(["--suite", "--suite-rows", "1",
+                    "--suite-models", "resnet50"])
+
+
+def test_suite_budget_zero_disables_gating(bench, monkeypatch, capsys):
+    seen = []
+
+    def fake_measure(row, emit_quick=True, emit_final=True, deadline=None):
+        seen.append((row.model, deadline))
+        return 1.0
+
+    monkeypatch.setattr(bench, "_child_measure", fake_measure)
+    monkeypatch.setattr(bench, "SUITE", (("resnet50", {}, 10_000),))
+    args = _args(bench, ["--suite", "--suite-budget", "0"])
+    _run_child_with_fake_jax(bench, args)
+    assert seen == [("resnet50", None)]
+
+
+def test_parent_derives_child_suite_budget(bench):
+    """The parent forwards --suite-budget = --budget minus the init margin
+    unless explicitly overridden, so the child's row gating always engages
+    on driver-style invocations (bench.py --suite --budget N)."""
+    argv = ["--suite", "--budget", "520"]
+    derived = {}
+
+    def fake_attempt(cmd, timeout, *, relay_errors, record_good=True,
+                     preflight=0):
+        derived["cmd"] = list(cmd)
+        return 1, "", 0
+
+    orig = bench._run_attempt
+    bench._run_attempt = fake_attempt
+    try:
+        bench.main(argv)
+    finally:
+        bench._run_attempt = orig
+    i = derived["cmd"].index("--suite-budget")
+    # Derived per-attempt from the REMAINING budget (520 minus elapsed,
+    # minus the 120s relay margin) — a second attempt gets a smaller one.
+    assert 395 <= int(derived["cmd"][i + 1]) <= 400
+
+
+def test_metric_line_carries_tflops_and_fused_block_field(bench, capsys):
+    """MFU reporting contract (VERDICT r4 Next #5) + the structured
+    fused-block marker (ADVICE r4): the emitted record computes
+    tflops_per_sec from the analytic model FLOPs, and mfu_pct appears
+    exactly when the detected chip has a known bf16 peak."""
+    from distributeddeeplearning_tpu.models import flops as flopslib
+
+    args = _args(bench, ["--model", "resnet50"])
+    args.fused_block = True
+    bench._emit_metric(args, 2366.0, protocol="w11+30 b512")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    per_ex = flopslib.train_flops_per_example("resnet50")
+    assert rec["tflops_per_sec"] == round(2366.0 * per_ex / 1e12, 2)
+    assert rec["fused_block"] is True
+    # This test runs on CPU (unknown peak): mfu_pct must be absent, not
+    # wrong. On a detected TPU it must match the peak-table arithmetic.
+    import jax
+    peak = flopslib.bf16_peak_flops(jax.devices()[0].device_kind)
+    if peak:
+        assert rec["mfu_pct"] == round(
+            100.0 * 2366.0 * per_ex / peak, 1)
+    else:
+        assert "mfu_pct" not in rec
+
+
+def test_unknown_model_omits_mfu_fields(bench, capsys):
+    args = _args(bench, ["--model", "resnet50"])
+    args.model = "bert_tiny"  # no flops entry by design
+    args.seq_len = 64
+    bench._emit_metric(args, 10.0, protocol="x")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "tflops_per_sec" not in rec and "mfu_pct" not in rec
+    assert "fused_block" not in rec  # marker only when the flag is set
 
 
 def test_last_good_cache_keyed_per_metric(bench, tmp_path):

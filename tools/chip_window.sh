@@ -1,8 +1,34 @@
 #!/bin/bash
-# Run every pending on-chip measurement in priority order, one log per step.
-# Usage: tools/chip_window.sh [results_dir]   (default .chip_results)
-# Each step gets a hard timeout so one hang can't burn the whole window;
-# steps append to RES so partial windows still leave evidence.
+# Run every pending on-chip measurement in VALUE-PER-MINUTE order, one log
+# per step. Usage: tools/chip_window.sh [results_dir]  (default .chip_results)
+#
+# Window economics (VERDICT r4 Weak #1): the only tunnel window ever
+# observed was ~25 minutes (2026-07-31, ~03:47-04:10 UTC), so the priority
+# prefix — steps 1-5 — is budgeted to fit it at P50: ~3 + ~5 + ~7 + ~3 +
+# ~2 = ~20 min, from measured sessions (round-3's headline landed in one
+# attempt ~3 min after the chip answered with a warm compile cache; a
+# suite row costs ~60-120 s per BASELINE.md round-2/3; the A/B is two
+# ~90 s measurements plus compile). The per-step `timeout`s are HANG
+# GUARDS, not budgets — they only strike when a tool stalls past its own
+# internal budget, and their sum (~33 min) intentionally exceeds the
+# window: if every guard strikes, the tunnel died and no ordering could
+# have saved the window. The real budget discipline lives inside each
+# tool (bench.py --budget / --suite-budget row gating, --leg-timeout),
+# which emit parseable partial output when cut.
+#
+# Priority rationale:
+#   1. headline  — the metric of record, 4 rounds unmeasured (Missing #1);
+#                  also warms the compile cache for the driver's own run.
+#   2. fused-block A/B — the round-3/4 kernel-campaign verdict, the single
+#                  most valuable unknown (was behind the suite in r4).
+#   3. top suite rows — resnet50 + the never-measured gather-head BERT
+#                  flash + gpt2 rows; bench.py now orders rows by value
+#                  and cuts them on budget, so a dying window still yields
+#                  the best prefix.
+#   4. real-data tf leg — loader->device_put->train overlap on TPU, never
+#                  measured (Weak #4).
+#   5. MFU profile — where the fused-block step spends its time.
+#   6+. everything else, cheapest-first within similar value.
 set -u
 cd "$(dirname "$0")/.."
 RES="$(realpath -m "${1:-.chip_results}")"  # absolute: survives the cd above
@@ -12,22 +38,66 @@ note() { rc=$?; echo "[$(stamp)] $1 rc=$rc" >> "$RES/log.txt"; }
 
 echo "[$(stamp)] window open" >> "$RES/log.txt"
 
-# 1. Headline bench (refreshes compile cache for the driver's run).
-timeout 600 python bench.py > "$RES/bench_headline.json" 2>> "$RES/log.txt"
+# --- Priority prefix: fits a ~25-min window -------------------------------
+
+# 1. Headline bench, quick protocol first (P50 ~3 min warm-cache; the
+# progressive quick line lands ~60 s after backend-up even cold). The batch
+# sweep + fused-block alternate stay ON (sweep auto): they only emit on a
+# strict win and this is the one shot at catching the sweet-spot flip.
+timeout 420 python bench.py --budget 400 --attempts 1 \
+  > "$RES/bench_headline.json" 2>> "$RES/log.txt"
 note headline
 
-# 2. Acceptance-suite rows (all configs, one child process).
-timeout 1500 python bench.py --suite --budget 1400 \
-  > "$RES/bench_suite.json" 2>> "$RES/log.txt"
-note suite
-
-# 3. Fused-block step A/B vs unfused (the round-3 kernel project).
-timeout 900 python tools/ab_fused_block.py --batches 256,512 \
+# 2. Fused-block step A/B vs unfused (the round-3/4 kernel verdict).
+# P50 ~5 min: two configs x (warm compile + ~40 timed steps) at b512.
+timeout 480 python tools/ab_fused_block.py --batches 512 \
   > "$RES/fused_block_ab.json" 2>> "$RES/log.txt"
 note fused_block
 
-# 4. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).
-timeout 600 python - > "$RES/matmul_micro.json" 2>> "$RES/log.txt" <<'EOF'
+# 3. Highest-value suite rows under an explicit row budget: SUITE rows
+# 0-3 = resnet50 (acceptance row, cache hot from step 1), BERT-512 flash,
+# gpt2, BERT-512 dense (gather-head protocol, never measured on chip).
+# bench.py admits rows against the budget and cuts overruns, so this step
+# degrades to the best prefix rather than overshooting. P50 ~7 min.
+timeout 540 python bench.py --suite --budget 520 --suite-rows 0,1,2,3 \
+  > "$RES/bench_suite_top.json" 2>> "$RES/log.txt"
+note suite_top
+
+# 4. Real-pixels end-to-end, tf.data loader: disk JPEGs -> decode ->
+# device_put -> train -> eval on the real chip — the loader/train overlap
+# number (corpus pre-generated under .cache/real_jpegs; never spend window
+# time on PIL). --loaders tf still runs THREE legs (synthetic baseline,
+# tf, tf_resume), so the guard is 3 x leg-timeout + slack. P50 ~3 min.
+timeout 520 python tools/real_data_on_chip.py --steps 100 --loaders tf \
+  --leg-timeout 150 > "$RES/real_data_tf.json" 2>> "$RES/log.txt"
+note real_data_tf
+
+# 5. Profile the fused-block step (where does its time go — reads on the
+# A/B either way it lands). P50 ~2 min warm.
+timeout 300 python tools/profile_step.py --model resnet50 --batch-size 512 \
+  --fused-block --top 25 > "$RES/profile_fused_block.json" 2>> "$RES/log.txt"
+note profile
+echo "[$(stamp)] priority prefix done" >> "$RES/log.txt"
+
+# --- Extended batch: runs only while the window stays open ----------------
+
+# 6. Remaining suite rows: SUITE rows 4-7 = resnet152, densenet121,
+# vit_b16, bert-2048 flash+remat (exact-row selection — a model-name
+# filter would re-admit the bert rows step 3 already measured).
+timeout 900 python bench.py --suite --budget 860 --suite-rows 4,5,6,7 \
+  > "$RES/bench_suite_rest.json" 2>> "$RES/log.txt"
+note suite_rest
+
+# 7. Remaining real-data legs: native C++ loader + grain only (tf was
+# step 4; re-running it would spend window time on duplicates). 5 legs
+# (synthetic baseline + 2 loaders + 2 resumes) x 180s + slack.
+timeout 1100 python tools/real_data_on_chip.py --steps 100 \
+  --loaders native,grain --leg-timeout 180 \
+  > "$RES/real_data.json" 2>> "$RES/log.txt"
+note real_data
+
+# 8. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).
+timeout 420 python - > "$RES/matmul_micro.json" 2>> "$RES/log.txt" <<'EOF'
 import json, sys, time
 sys.path.insert(0, ".")
 import jax, jax.numpy as jnp
@@ -61,12 +131,7 @@ for m, k, n in ((802816, 64, 256), (200704, 128, 512), (50176, 256, 1024),
 EOF
 note matmul_micro
 
-# 5. Profile the fused-block step (where does its time go).
-timeout 600 python tools/profile_step.py --model resnet50 --batch-size 256 \
-  --fused-block --top 25 > "$RES/profile_fused_block.json" 2>> "$RES/log.txt"
-note profile
-
-# 6. XLA-flag sweep on the headline config (quick protocol): any free wins
+# 9. XLA-flag sweep on the headline config (quick protocol): any free wins
 # from scheduler/memory knobs the default compile doesn't enable. The jax
 # compilation cache keys on the flags, so cached default executables don't
 # mask these runs.
@@ -80,22 +145,15 @@ for flags in \
     --sweep none >> "$RES/xla_flag_sweep.json" 2>> "$RES/log.txt"
   note "xla_$tag"
 done
-# 7. Decode throughput (serving-side): GPT-2 KV-cache vs refeed.
+
+# 10. Decode throughput (serving-side): GPT-2 KV-cache vs refeed.
 timeout 600 python tools/bench_generate.py --model gpt2_small --batch 8 \
   --prompt-len 128 --new-tokens 128 > "$RES/decode_throughput.json" \
   2>> "$RES/log.txt"
 note decode
 
-# 8. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
+# 11. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
 timeout 600 python tools/validate_flash_tpu.py \
   > "$RES/flash_validate.json" 2>> "$RES/log.txt"
 note flash
-
-# 9. Real-pixels end-to-end: disk JPEGs -> decode -> HBM -> train -> eval
-# -> mid-run resume, through all three loaders (corpus pre-generated under
-# .cache/real_jpegs — never spend window time on PIL).
-# 7 legs x 180s fits the outer budget with slack for corpus checks.
-timeout 1500 python tools/real_data_on_chip.py --steps 100 \
-  --leg-timeout 180 > "$RES/real_data.json" 2>> "$RES/log.txt"
-note real_data
 echo "[$(stamp)] window done" >> "$RES/log.txt"

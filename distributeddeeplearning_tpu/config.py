@@ -141,6 +141,9 @@ class TrainConfig:
     fused_bn: bool = False        # Pallas fused BN+ReLU kernels (CNNs)
     fused_block: bool = False     # conv-epilogue fusion: bottleneck 1x1
                                   # convs as Pallas matmul+BN (resnet50+)
+    fused_conv3: bool = False     # fused_block v2: stride-1 3x3 convs as
+                                  # Pallas conv+BN (ops/fused_conv_bn.py);
+                                  # requires fused_block
     sync_bn: bool = False         # cross-replica BN statistics (psum over
                                   # the data axis; torch SyncBatchNorm)
     # GPipe microbatch count for *_pp models (None = model default). The

@@ -1,0 +1,453 @@
+"""3x3 convolution with BN prologue/epilogue — conv-epilogue fusion for
+the bottleneck's spatial conv (VERDICT r4 Next #2).
+
+ops/fused_linear_bn.py covers the 1x1 convolutions (matmuls over
+M = B.H.W rows); after it, the remaining BN traffic in a bottleneck block
+rides the 3x3: bn1's apply must materialize a normalized tensor as the
+XLA conv's input, and bn2's statistics are a separate full read of the
+conv's output (BASELINE.md round-2 profile: the BN statistics passes sit
+at the HBM roofline). This module fuses both into the convolution itself:
+
+    a  = relu((x_raw − μ)·inv·γ + β)     -- prologue, on VMEM tiles
+    y  = conv3x3(a, w), stride 1, pad 1  -- in-VMEM im2col + one MXU dot
+    s  = Σ y,  ss = Σ y²                 -- epilogue, per out-channel
+
+so the raw conv1 output streams straight into the MXU (no materialized a1)
+and bn2's sums ride tiles the conv already wrote. Per block this removes
+~2 full f-channel activation passes versus the v1 fused path.
+
+Implementation: the kernel walks (batch, row-block) grid cells; each cell
+DMAs a (th+2, W+2, Cin) halo slab from HBM (three conditional copies:
+body rows always, one top / one bottom halo row when they exist),
+normalizes it on the VPU, ZEROES everything outside the image (halo rows
+beyond the border, the two side columns — so SAME-padding semantics hold
+on *normalized* activations exactly as XLA's pad-then-conv), builds the
+(th·W, 9·Cin) patch matrix in VMEM (free of HBM traffic — the 9x read
+amplification of materialized im2col is the whole reason this is a
+kernel), and issues ONE (th·W, 9Cin) x (9Cin, Cout) MXU dot.
+
+Backward (same two-matmul structure as fused_linear_bn):
+
+    dY = dy + ds + 2·y·dss                  (epilogue cotangents folded)
+    da = conv3x3(dY, flip(w)ᵀ)              (kernel 1: patch matmul over
+    dzl = da·1[z>0];  dx = dzl·γ·inv         dY's halo slab; epilogue
+    dβ = Σ dzl;  dγ = Σ dzl·x̂               writes dx, dβ, dγ)
+    dw[t] = aᵀ_shifted @ dY                 (kernel 2: a recomputed in its
+                                             prologue; (9Cin, Cout) acc)
+    dμ = −γ·inv·dβ;  dinv = γ·dγ/inv        (vector math, outside)
+
+Stride-2 bottlenecks keep the XLA conv path (models/fused_block.py falls
+back per block); the fusion targets the 13/16 stride-1 blocks where the
+traffic lives. bf16 reads, f32 accumulation, interpret mode off-TPU, jnp
+twins under shard_map's check_vma — the ops/fused_batchnorm.py policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributeddeeplearning_tpu.ops.fused_batchnorm import (
+    _jnp_twin, _match_vma, _should_interpret, _struct, _tile)
+
+
+def _row_block(h: int, w: int) -> int:
+    """Rows per tile: the largest divisor of H keeping th·W near the MXU
+    sweet spot (and the halo slab comfortably in VMEM)."""
+    return _tile(h, max(1, 512 // w))
+
+
+def _normalize_mask(slab, mu, inv, g, b, *, relu: bool, bn: bool,
+                    r0, h: int, w: int, out_dtype):
+    """Prologue + SAME-padding semantics: bn(+relu) the halo slab, then
+    zero every position outside the image. ``r0`` is the global row of
+    slab row 1 (slab row j holds global row r0 - 1 + j)."""
+    th2, w2, _ = slab.shape
+    a = slab.astype(jnp.float32)
+    if bn:
+        a = (a - mu) * (inv * g) + b
+        if relu:
+            a = jnp.maximum(a, 0.0)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (th2, w2, 1), 0) + (r0 - 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (th2, w2, 1), 1)
+    valid = ((rows >= 0) & (rows < h) & (cols >= 1) & (cols <= w))
+    return jnp.where(valid, a, 0.0).astype(out_dtype)
+
+
+def _patches(a, th: int, w: int, cin: int):
+    """(th+2, W+2, C) normalized slab -> (th*W, 9C) im2col matrix, tap
+    order (dy, dx) lexicographic — matching w.reshape(9C, Cout)."""
+    parts = [a[dy:dy + th, dx:dx + w, :] for dy in range(3)
+             for dx in range(3)]
+    return jnp.concatenate(parts, axis=-1).reshape(th * w, 9 * cin)
+
+
+def _start_slab_dmas(x_any, slab, sem, b_i, r, *, th: int, nh: int):
+    """Three conditional copies of the halo slab's valid rows; returns the
+    descriptors so the caller can wait on exactly the ones started."""
+    r0 = r * th
+    mid = pltpu.make_async_copy(
+        x_any.at[b_i, pl.ds(r0, th)],
+        slab.at[pl.ds(1, th), pl.ds(1, slab.shape[1] - 2)], sem.at[0])
+    mid.start()
+    top = pltpu.make_async_copy(
+        x_any.at[b_i, pl.ds(jnp.maximum(r0 - 1, 0), 1)],
+        slab.at[pl.ds(0, 1), pl.ds(1, slab.shape[1] - 2)], sem.at[1])
+    bot = pltpu.make_async_copy(
+        x_any.at[b_i, pl.ds(jnp.minimum(r0 + th, (nh * th) - 1), 1)],
+        slab.at[pl.ds(th + 1, 1), pl.ds(1, slab.shape[1] - 2)], sem.at[2])
+
+    @pl.when(r > 0)
+    def _():
+        top.start()
+
+    @pl.when(r < nh - 1)
+    def _():
+        bot.start()
+
+    mid.wait()
+
+    @pl.when(r > 0)
+    def _():
+        top.wait()
+
+    @pl.when(r < nh - 1)
+    def _():
+        bot.wait()
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_any, w_ref, mu_ref, inv_ref, g_ref, b_ref,
+                y_ref, s_ref, ss_ref, slab, sem, s_scr, ss_scr, *,
+                relu: bool, bn: bool, th: int, h: int, w: int, cin: int,
+                nb: int, nh: int):
+    b_i, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((b_i == 0) & (r == 0))
+    def _():
+        s_scr[...] = jnp.zeros_like(s_scr)
+        ss_scr[...] = jnp.zeros_like(ss_scr)
+
+    _start_slab_dmas(x_any, slab, sem, b_i, r, th=th, nh=nh)
+    a = _normalize_mask(slab[...], mu_ref[...], inv_ref[...], g_ref[...],
+                        b_ref[...], relu=relu, bn=bn, r0=r * th, h=h, w=w,
+                        out_dtype=y_ref.dtype)
+    acc = jax.lax.dot(_patches(a, th, w, cin), w_ref[...],
+                      preferred_element_type=jnp.float32)
+    y_cast = acc.astype(y_ref.dtype)
+    y_ref[0] = y_cast.reshape(th, w, -1)
+    # Statistics over y AS STORED (match what the next prologue will read).
+    yf = y_cast.astype(jnp.float32)
+    s_scr[...] += yf.sum(axis=0, keepdims=True)
+    ss_scr[...] += (yf * yf).sum(axis=0, keepdims=True)
+
+    @pl.when((b_i == nb - 1) & (r == nh - 1))
+    def _():
+        s_ref[...] = s_scr[...]
+        ss_ref[...] = ss_scr[...]
+
+
+def _fwd(x, mu, inv, gamma, beta, w, relu, bn,
+         interpret: Optional[bool] = None):
+    nb, h, ww, cin = x.shape
+    cout = w.shape[-1]
+    th = _row_block(h, ww)
+    nh = h // th
+    interp = _should_interpret() if interpret is None else interpret
+    w2r = w.reshape(9 * cin, cout).astype(x.dtype)
+    vec = pl.BlockSpec((1, cin), lambda b_i, r: (0, 0))
+    y, s, ss = pl.pallas_call(
+        functools.partial(_fwd_kernel, relu=relu, bn=bn, th=th, h=h, w=ww,
+                          cin=cin, nb=nb, nh=nh),
+        grid=(nb, nh),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec((9 * cin, cout), lambda b_i, r: (0, 0)),
+                  vec, vec, vec, vec],
+        out_specs=[pl.BlockSpec((1, th, ww, cout),
+                                lambda b_i, r: (b_i, r, 0, 0)),
+                   pl.BlockSpec((1, cout), lambda b_i, r: (0, 0)),
+                   pl.BlockSpec((1, cout), lambda b_i, r: (0, 0))],
+        out_shape=[_struct((nb, h, ww, cout), x.dtype, x),
+                   _struct((1, cout), jnp.float32, x),
+                   _struct((1, cout), jnp.float32, x)],
+        scratch_shapes=[pltpu.VMEM((th + 2, ww + 2, cin), x.dtype),
+                        pltpu.SemaphoreType.DMA((3,)),
+                        pltpu.VMEM((1, cout), jnp.float32),
+                        pltpu.VMEM((1, cout), jnp.float32)],
+        interpret=interp,
+    )(x, w2r, mu[None], inv[None], gamma[None], beta[None])
+    return y, s[0], ss[0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel 1: da via flipped-kernel conv on dY; epilogue dx, dβ, dγ
+# ---------------------------------------------------------------------------
+
+def _bwd_dx_kernel(dy_any, y_any, ds_ref, dss_ref, wf_ref, x_ref,
+                   mu_ref, inv_ref, g_ref, b_ref,
+                   dx_ref, db_ref, dg_ref,
+                   slab_dy, slab_y, sem_dy, sem_y, db_scr, dg_scr, *,
+                   relu: bool, bn: bool, th: int, h: int, w: int, cout: int,
+                   nb: int, nh: int):
+    b_i, r = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((b_i == 0) & (r == 0))
+    def _():
+        db_scr[...] = jnp.zeros_like(db_scr)
+        dg_scr[...] = jnp.zeros_like(dg_scr)
+
+    _start_slab_dmas(dy_any, slab_dy, sem_dy, b_i, r, th=th, nh=nh)
+    _start_slab_dmas(y_any, slab_y, sem_y, b_i, r, th=th, nh=nh)
+    # dY = dy + ds + 2 y dss on the slab; zero outside the image (those
+    # output positions do not exist, so they contribute nothing).
+    dyf = (slab_dy[...].astype(jnp.float32) + ds_ref[...]
+           + 2.0 * slab_y[...].astype(jnp.float32) * dss_ref[...])
+    th2, w2, _ = slab_dy.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (th2, w2, 1), 0) + (r * th - 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (th2, w2, 1), 1)
+    valid = ((rows >= 0) & (rows < h) & (cols >= 1) & (cols <= w))
+    dyt = jnp.where(valid, dyf, 0.0).astype(dx_ref.dtype)
+    da = jax.lax.dot(_patches(dyt, th, w, cout), wf_ref[...],
+                     preferred_element_type=jnp.float32)
+    if bn:
+        xf = x_ref[0].reshape(th * w, -1).astype(jnp.float32)
+        xh = (xf - mu_ref[...]) * inv_ref[...]
+        dzl = da
+        if relu:
+            z = xh * g_ref[...] + b_ref[...]
+            dzl = jnp.where(z > 0, da, 0.0)
+        dx_ref[0] = (dzl * (g_ref[...] * inv_ref[...])).reshape(
+            th, w, -1).astype(dx_ref.dtype)
+        db_scr[...] += dzl.sum(axis=0, keepdims=True)
+        dg_scr[...] += (dzl * xh).sum(axis=0, keepdims=True)
+    else:
+        dx_ref[0] = da.reshape(th, w, -1).astype(dx_ref.dtype)
+
+    @pl.when((b_i == nb - 1) & (r == nh - 1))
+    def _():
+        db_ref[...] = db_scr[...]
+        dg_ref[...] = dg_scr[...]
+
+
+def _bwd_dx(dy, y, ds, dss, w, x, mu, inv, gamma, beta, relu, bn,
+            interpret: Optional[bool] = None):
+    nb, h, ww, cin = x.shape
+    cout = w.shape[-1]
+    th = _row_block(h, ww)
+    nh = h // th
+    interp = _should_interpret() if interpret is None else interpret
+    # flip(w)ᵀ: da[i] = Σ_u dY[i+u] @ w[1-u]ᵀ, tap order (du, dx') must
+    # match _patches' lexicographic order over the dY slab.
+    wf = w[::-1, ::-1].transpose(0, 1, 3, 2).reshape(9 * cout, cin)
+    wf = wf.astype(dy.dtype)
+    vk = pl.BlockSpec((1, cin), lambda b_i, r: (0, 0))
+    vn = pl.BlockSpec((1, cout), lambda b_i, r: (0, 0))
+    dx, db, dg = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, relu=relu, bn=bn, th=th, h=h,
+                          w=ww, cout=cout, nb=nb, nh=nh),
+        grid=(nb, nh),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  vn, vn,
+                  pl.BlockSpec((9 * cout, cin), lambda b_i, r: (0, 0)),
+                  pl.BlockSpec((1, th, ww, cin),
+                               lambda b_i, r: (b_i, r, 0, 0)),
+                  vk, vk, vk, vk],
+        out_specs=[pl.BlockSpec((1, th, ww, cin),
+                                lambda b_i, r: (b_i, r, 0, 0)),
+                   vk, vk],
+        out_shape=[_struct((nb, h, ww, cin), x.dtype, x),
+                   _struct((1, cin), jnp.float32, x),
+                   _struct((1, cin), jnp.float32, x)],
+        scratch_shapes=[pltpu.VMEM((th + 2, ww + 2, cout), dy.dtype),
+                        pltpu.VMEM((th + 2, ww + 2, cout), y.dtype),
+                        pltpu.SemaphoreType.DMA((3,)),
+                        pltpu.SemaphoreType.DMA((3,)),
+                        pltpu.VMEM((1, cin), jnp.float32),
+                        pltpu.VMEM((1, cin), jnp.float32)],
+        interpret=interp,
+    )(dy, y, ds[None], dss[None], wf, x, mu[None], inv[None],
+      gamma[None], beta[None])
+    return dx, db[0], dg[0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel 2: dw[t] = aᵀ_shifted @ dY, a recomputed in the prologue
+# ---------------------------------------------------------------------------
+
+def _bwd_dw_kernel(x_any, mu_ref, inv_ref, g_ref, b_ref,
+                   dy_ref, y_ref, ds_ref, dss_ref,
+                   dw_ref, slab, sem, acc, *,
+                   relu: bool, bn: bool, th: int, h: int, w: int, cin: int,
+                   nb: int, nh: int):
+    b_i, r = pl.program_id(1), pl.program_id(2)
+
+    @pl.when((b_i == 0) & (r == 0))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    _start_slab_dmas(x_any, slab, sem, b_i, r, th=th, nh=nh)
+    a = _normalize_mask(slab[...], mu_ref[...], inv_ref[...], g_ref[...],
+                        b_ref[...], relu=relu, bn=bn, r0=r * th, h=h, w=w,
+                        out_dtype=dy_ref.dtype)
+    yf = y_ref[0].reshape(th * w, -1).astype(jnp.float32)
+    dyf = (dy_ref[0].reshape(th * w, -1).astype(jnp.float32)
+           + ds_ref[...] + 2.0 * yf * dss_ref[...])
+    # aᵀ_shifted @ dY: contract the row axis of the patch matrix.
+    acc[...] += jax.lax.dot_general(
+        _patches(a, th, w, cin), dyf.astype(dy_ref.dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when((b_i == nb - 1) & (r == nh - 1))
+    def _():
+        dw_ref[...] = acc[...].astype(dw_ref.dtype)
+
+
+def _bwd_dw(x, mu, inv, gamma, beta, dy, y, ds, dss, relu, bn,
+            interpret: Optional[bool] = None):
+    nb, h, ww, cin = x.shape
+    cout = dy.shape[-1]
+    th = _row_block(h, ww)
+    nh = h // th
+    tn = _tile(cout, 256)  # bound the (9Cin, tn) f32 accumulator in VMEM
+    interp = _should_interpret() if interpret is None else interpret
+    vk = pl.BlockSpec((1, cin), lambda ni, b_i, r: (0, 0))
+    vn = pl.BlockSpec((1, tn), lambda ni, b_i, r: (0, ni))
+    ys = pl.BlockSpec((1, th, ww, tn), lambda ni, b_i, r: (b_i, r, 0, ni))
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, relu=relu, bn=bn, th=th, h=h,
+                          w=ww, cin=cin, nb=nb, nh=nh),
+        grid=(cout // tn, nb, nh),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  vk, vk, vk, vk, ys, ys, vn, vn],
+        out_specs=pl.BlockSpec((9 * cin, tn), lambda ni, b_i, r: (0, ni)),
+        out_shape=_struct((9 * cin, cout), dy.dtype, x),
+        scratch_shapes=[pltpu.VMEM((th + 2, ww + 2, cin), x.dtype),
+                        pltpu.SemaphoreType.DMA((3,)),
+                        pltpu.VMEM((9 * cin, tn), jnp.float32)],
+        interpret=interp,
+    )(x, mu[None], inv[None], gamma[None], beta[None], dy, y,
+      ds[None], dss[None])
+    return dw.reshape(3, 3, cin, cout)
+
+
+# ---------------------------------------------------------------------------
+# jnp twin and the public custom-VJP op
+# ---------------------------------------------------------------------------
+
+def _twin_a(x, mu, inv, gamma, beta, relu, bn):
+    if not bn:
+        return x
+    a = (x.astype(jnp.float32) - mu) * (inv * gamma) + beta
+    if relu:
+        a = jnp.maximum(a, 0.0)
+    return a.astype(x.dtype)
+
+
+def _conv3(a, w):
+    return lax.conv_general_dilated(
+        a, w.astype(a.dtype), window_strides=(1, 1),
+        padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def _twin_fwd(x, mu, inv, gamma, beta, w, relu, bn):
+    a = _twin_a(x, mu, inv, gamma, beta, relu, bn)
+    y = _conv3(a, w).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, yf.sum(axis=(0, 1, 2)), (yf * yf).sum(axis=(0, 1, 2))
+
+
+def _twin_bwd(dy, ds, dss, x, mu, inv, gamma, beta, w, y, relu, bn):
+    yf = y.astype(jnp.float32)
+    dyf = (dy.astype(jnp.float32) + ds + 2.0 * yf * dss).astype(dy.dtype)
+    a = _twin_a(x, mu, inv, gamma, beta, relu, bn)
+    # f32 vjp: a bf16 conv with preferred f32 output transposes into a
+    # conv over mixed (f32 cotangent, bf16 weight) operands, which lax
+    # rejects; the twin is the CPU/check_vma path, so full f32 is both
+    # legal and the better reference.
+    _, vjp = jax.vjp(lambda a_, w_: _conv3(a_, w_),
+                     a.astype(jnp.float32), w.astype(jnp.float32))
+    da, dw = vjp(dyf.astype(jnp.float32))
+    da = da.astype(jnp.float32)
+    if bn:
+        xh = (x.astype(jnp.float32) - mu) * inv
+        dzl = da
+        if relu:
+            z = xh * gamma + beta
+            dzl = jnp.where(z > 0, da, 0.0)
+        dx = (dzl * (gamma * inv)).astype(x.dtype)
+        db = dzl.sum(axis=(0, 1, 2))
+        dg = (dzl * xh).sum(axis=(0, 1, 2))
+    else:
+        dx = da.astype(x.dtype)
+        db = dg = jnp.zeros_like(mu)
+    return dx, db, dg, dw.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def bn_conv3x3_stats(x, mu, inv, gamma, beta, w, relu: bool = True,
+                     bn: bool = True):
+    """y = conv3x3(relu((x−μ)·inv·γ + β), w) with per-out-channel (Σy, Σy²).
+
+    x: (B, H, W, Cin) raw previous-layer output; w: (3, 3, Cin, Cout);
+    stride 1, SAME (pad 1). With ``bn=False`` the prologue is the identity
+    (μ/inv/γ/β ignored but must be (Cin,)-shaped). Returns ``(y, s, ss)``.
+    """
+    y, s, ss = _fwd_any(x, mu, inv, gamma, beta, w, relu, bn)
+    return y, s, ss
+
+
+def _fwd_any(x, mu, inv, gamma, beta, w, relu, bn):
+    if _jnp_twin(x):
+        return _twin_fwd(x, mu, inv, gamma, beta, w, relu, bn)
+    return _fwd(x, mu, inv, gamma, beta, w, relu, bn)
+
+
+def _vjp_fwd(x, mu, inv, gamma, beta, w, relu, bn):
+    y, s, ss = _fwd_any(x, mu, inv, gamma, beta, w, relu, bn)
+    return (y, s, ss), (x, mu, inv, gamma, beta, w, y)
+
+
+def _vjp_bwd(relu, bn, saved, cots):
+    x, mu, inv, gamma, beta, w, y = saved
+    dy, ds, dss = cots
+    if _jnp_twin(x):
+        dx, db, dg, dw = _twin_bwd(dy, ds, dss, x, mu, inv, gamma, beta,
+                                   w, y, relu, bn)
+    else:
+        dx, db, dg = _bwd_dx(dy, y, ds, dss, w, x, mu, inv, gamma, beta,
+                             relu, bn)
+        dw = _bwd_dw(x, mu, inv, gamma, beta, dy, y, ds, dss, relu, bn)
+    dw = _match_vma(dw.astype(jnp.float32), w)
+    if not bn:
+        zero = jnp.zeros_like(mu)
+        return (dx, zero, zero, zero, zero, dw)
+    dmu = -gamma * inv * db
+    dinv = gamma * dg / inv
+    return (dx,
+            _match_vma(dmu, mu), _match_vma(dinv, inv),
+            _match_vma(dg.astype(gamma.dtype), gamma),
+            _match_vma(db.astype(beta.dtype), beta),
+            dw)
+
+
+bn_conv3x3_stats.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def conv3x3_stats(x, w):
+    """y = conv3x3(x, w) with (Σy, Σy²) — identity prologue (the shape for
+    inputs that are already materialized activations)."""
+    zeros = jnp.zeros((x.shape[-1],), jnp.float32)
+    return bn_conv3x3_stats(x, zeros, zeros, zeros, zeros, w, False, False)

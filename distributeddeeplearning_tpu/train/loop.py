@@ -83,6 +83,8 @@ def build(config: TrainConfig, total_steps: int):
         kw["fused_bn"] = True
     if config.fused_block:
         kw["fused_block"] = True
+    if config.fused_conv3:
+        kw["fused_conv3"] = True
     if config.sync_bn:
         # Cross-replica BN needs the named mesh axes of the explicit
         # shard_map path; the GSPMD path has no manual axes to pmean over.

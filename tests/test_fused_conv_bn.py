@@ -1,0 +1,179 @@
+"""ops/fused_conv_bn.py — the fused-block v2 3x3 conv kernel.
+
+Kernel (interpret mode) vs jnp twin vs the classic unfused composition
+(bn-apply -> lax conv -> stats reduce), forward and VJP, plus the
+block/model level through ResNet(fused_conv3=True). CPU-tractable shapes;
+the on-chip compiled validation is staged in tools/chip_window.sh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearning_tpu.models.resnet import (
+    BottleneckBlock, ResNet)
+from distributeddeeplearning_tpu.ops import fused_conv_bn as fc
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _inputs(B=2, H=8, W=6, Cin=8, Cout=16, key=0):
+    ks = jax.random.split(jax.random.key(key), 6)
+    x = jax.random.normal(ks[0], (B, H, W, Cin), jnp.float32)
+    w = jax.random.normal(ks[1], (3, 3, Cin, Cout)) * 0.1
+    mu = x.mean(axis=(0, 1, 2))
+    inv = jax.lax.rsqrt(x.var(axis=(0, 1, 2)) + 1e-5)
+    g = jnp.abs(jax.random.normal(ks[2], (Cin,))) + 0.5
+    b = jax.random.normal(ks[3], (Cin,)) * 0.1
+    return x, mu, inv, g, b, w
+
+
+def _reference(x, mu, inv, g, b, w, relu, bn):
+    """The unfused composition the kernel must reproduce."""
+    a = x.astype(jnp.float32)
+    if bn:
+        a = (a - mu) * (inv * g) + b
+        if relu:
+            a = jnp.maximum(a, 0.0)
+    a = a.astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        a, w.astype(a.dtype), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return y, yf.sum(axis=(0, 1, 2)), (yf * yf).sum(axis=(0, 1, 2))
+
+
+@pytest.mark.core
+@pytest.mark.parametrize("relu,bn", [(True, True), (False, True),
+                                     (False, False)])
+def test_kernel_forward_matches_reference(relu, bn):
+    x, mu, inv, g, b, w = _inputs()
+    y_k, s_k, ss_k = fc._fwd(x, mu, inv, g, b, w, relu, bn)
+    y_r, s_r, ss_r = _reference(x, mu, inv, g, b, w, relu, bn)
+    np.testing.assert_allclose(y_k, y_r, rtol=1e-4, atol=3e-4)
+    np.testing.assert_allclose(s_k, s_r, rtol=2e-4, atol=1e-2)
+    np.testing.assert_allclose(ss_k, ss_r, rtol=2e-4, atol=1e-2)
+
+
+def test_kernel_multi_row_block_and_halo():
+    # W=64 forces th=8 over H=32 -> 4 row blocks per image: the top/bottom
+    # halo DMAs and the boundary masking all engage.
+    x, mu, inv, g, b, w = _inputs(B=2, H=32, W=64, Cin=8, Cout=16, key=7)
+    assert fc._row_block(32, 64) == 8
+    y_k, s_k, ss_k = fc._fwd(x, mu, inv, g, b, w, True, True)
+    y_r, s_r, ss_r = _reference(x, mu, inv, g, b, w, True, True)
+    np.testing.assert_allclose(y_k, y_r, rtol=1e-4, atol=3e-4)
+    np.testing.assert_allclose(s_k, s_r, rtol=2e-4, atol=5e-2)
+    np.testing.assert_allclose(ss_k, ss_r, rtol=2e-4, atol=5e-2)
+
+
+@pytest.mark.core
+def test_vjp_matches_autodiff_of_reference():
+    x, mu, inv, g, b, w = _inputs()
+    cot = jax.random.normal(jax.random.key(9), (3,))
+
+    def scalar(fn):
+        def run(x, mu, inv, g, b, w):
+            y, s, ss = fn(x, mu, inv, g, b, w)
+            return (cot[0] * (y.astype(jnp.float32) ** 2).sum()
+                    + cot[1] * s.sum() + cot[2] * (ss ** 2).sum())
+        return run
+
+    fused = scalar(lambda *a: fc.bn_conv3x3_stats(*a, True, True))
+    ref = scalar(lambda *a: _reference(*a, True, True))
+    grads_f = jax.grad(fused, argnums=(0, 1, 2, 3, 4, 5))(x, mu, inv, g, b, w)
+    grads_r = jax.grad(ref, argnums=(0, 1, 2, 3, 4, 5))(x, mu, inv, g, b, w)
+    for name, gf, gr in zip("x mu inv gamma beta w".split(),
+                            grads_f, grads_r):
+        err = float(jnp.abs(gf - gr).max())
+        den = float(jnp.abs(gr).max()) + 1e-9
+        assert err / den < 5e-3, (name, err, den)
+
+
+def test_conv3x3_stats_identity_prologue():
+    x, mu, inv, g, b, w = _inputs()
+    y_k, s_k, ss_k = fc.conv3x3_stats(x, w)
+    y_r, s_r, ss_r = _reference(x, mu, inv, g, b, w, False, False)
+    np.testing.assert_allclose(y_k, y_r, rtol=1e-4, atol=3e-4)
+    np.testing.assert_allclose(s_k, s_r, rtol=2e-4, atol=1e-2)
+
+
+def _tiny(fused_block, fused_conv3, dtype=jnp.float32):
+    return ResNet([1, 1], BottleneckBlock, num_classes=10, width=16,
+                  dtype=dtype, fused_block=fused_block,
+                  fused_conv3=fused_conv3)
+
+
+def test_model_forward_and_grads_match_unfused():
+    """ResNet(fused_conv3) vs the classic path, shared weights: forward,
+    batch-stats updates, and parameter gradients. The [1,1] net has a
+    stride-1 stage (kernel path) and a stride-2 stage (XLA fallback)."""
+    model_u = _tiny(False, False)
+    model_f = _tiny(True, True)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    variables = model_u.init(jax.random.key(1), x, train=True)
+
+    yu, su = model_u.apply(variables, x, train=True, mutable=["batch_stats"])
+    yf, sf = model_f.apply(variables, x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(yf, yu, rtol=2e-4, atol=3e-4)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(sf),
+            jax.tree_util.tree_leaves_with_path(su)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=3e-4,
+                                   err_msg=jax.tree_util.keystr(pa))
+
+    def loss(model, params):
+        y = model.apply({"params": params,
+                         "batch_stats": variables["batch_stats"]},
+                        x, train=True, mutable=["batch_stats"])[0]
+        return (y.astype(jnp.float32) ** 2).mean()
+
+    gu = jax.grad(lambda p: loss(model_u, p))(variables["params"])
+    gf = jax.grad(lambda p: loss(model_f, p))(variables["params"])
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gf),
+            jax.tree_util.tree_leaves_with_path(gu)):
+        den = float(jnp.abs(b).max()) + 1e-9
+        err = float(jnp.abs(a - b).max())
+        assert err / den < 5e-3, (jax.tree_util.keystr(pa), err, den)
+
+
+@pytest.mark.usefixtures("devices8")
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_conv3_dp_step_matches_unfused(dtype):
+    """Two DP train steps over the 8-device mesh: fused_conv3 on/off give
+    the same loss trajectory. This is the shard_map/check_vma jnp-twin
+    path — bf16 is parametrized because the twin's conv VJP once broke
+    only there (mixed-dtype conv transpose, caught by the A/B tool)."""
+    from distributeddeeplearning_tpu import data as datalib
+    from distributeddeeplearning_tpu.config import (
+        DataConfig, ParallelConfig, TrainConfig)
+    from distributeddeeplearning_tpu.train import loop
+
+    losses = {}
+    for fused in (False, True):
+        cfg = TrainConfig(
+            model="resnet26_thin", global_batch_size=32, dtype=dtype,
+            log_every=10**9, fused_block=fused, fused_conv3=fused,
+            parallel=ParallelConfig(data=8),
+            data=DataConfig(synthetic=True, image_size=32, num_classes=10,
+                            synthetic_learnable=True))
+        mesh, model, batch_shd, state, train_step, _, rng = loop.build(cfg, 2)
+        src = datalib.make_source(cfg, "image", batch_shd)
+        out = []
+        for i in range(2):
+            state, metrics = train_step(state, src.batch(i), rng)
+            out.append(float(metrics["loss"]))
+        losses[fused] = out
+    tol = 1e-5 if dtype == "float32" else 5e-2
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=tol, atol=tol)
+
+
+@pytest.mark.core
+def test_fused_conv3_requires_fused_block():
+    x = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="fused_conv3"):
+        _tiny(False, True).init(jax.random.key(0), x, train=True)

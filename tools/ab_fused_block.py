@@ -53,26 +53,38 @@ def main(argv=None) -> int:
     p.add_argument("--model", default="resnet50")
     p.add_argument("--batches", default="256,512")
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--conv3", action="store_true",
+                   help="also measure the v2 variant (stride-1 3x3 convs "
+                        "as Pallas conv+BN, --fused-conv3)")
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
 
+    variants = [("unfused", {}), ("fused", {"fused_block": True})]
+    if args.conv3:
+        # v2: 3x3 convs fused too (ops/fused_conv_bn.py). A separate
+        # variant, not a replacement — if Mosaic rejects the new kernel
+        # on-chip, the v1 verdict still lands.
+        variants.append(("fused_conv3", {"fused_block": True,
+                                         "fused_conv3": True}))
     for batch in (int(b) for b in args.batches.split(",")):
-        try:
-            base = step_rate(args.model, batch, args.steps)
-            fused = step_rate(args.model, batch, args.steps,
-                              fused_block=True)
-            print(json.dumps({
-                "check": "fused_block_ab", "model": args.model,
-                "batch": batch, "unfused": round(base, 1),
-                "fused": round(fused, 1),
-                "speedup": round(fused / base, 3)}), flush=True)
-        except Exception as e:  # one OOM must not sink the other batches
-            print(json.dumps({
-                "check": "fused_block_ab", "model": args.model,
-                "batch": batch,
-                "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+        rates = {}
+        for name, flags in variants:
+            try:
+                rates[name] = round(
+                    step_rate(args.model, batch, args.steps, **flags), 1)
+            except Exception as e:  # one failure must not sink the rest
+                rates[name] = None
+                rates[f"{name}_error"] = f"{type(e).__name__}: {e}"[:300]
+        rec = {"check": "fused_block_ab", "model": args.model,
+               "batch": batch, **rates}
+        base = rates.get("unfused")
+        if base:
+            for name, _ in variants[1:]:
+                if rates.get(name):
+                    rec[f"speedup_{name}"] = round(rates[name] / base, 3)
+        print(json.dumps(rec), flush=True)
     return 0
 
 

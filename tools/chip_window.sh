@@ -81,6 +81,16 @@ echo "[$(stamp)] priority prefix done" >> "$RES/log.txt"
 
 # --- Extended batch: runs only while the window stays open ----------------
 
+# 5b. Fused 3x3 conv kernel (fused_block v2): FIRST compiled-Mosaic smoke
+# at the extreme shapes — a rejection must cost seconds here, not the A/B
+# below. Then the three-way step A/B (unfused / v1 / v2).
+timeout 420 python tools/validate_fused_conv_tpu.py --quick \
+  > "$RES/fused_conv3_validate.json" 2>> "$RES/log.txt"
+note fused_conv3_validate
+timeout 700 python tools/ab_fused_block.py --batches 512 --conv3 \
+  > "$RES/fused_conv3_ab.json" 2>> "$RES/log.txt"
+note fused_conv3_ab
+
 # 6. Remaining suite rows: SUITE rows 4-7 = resnet152, densenet121,
 # vit_b16, bert-2048 flash+remat (exact-row selection — a model-name
 # filter would re-admit the bert rows step 3 already measured).

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""On-hardware validation of the fused 3x3 conv kernel (fused_block v2).
+
+COMPILED (not interpret) bn_conv3x3_stats forward + VJP at real ResNet50
+bottleneck shapes vs the unfused f32 reference, one JSON line per shape;
+then a single-kernel timing line per shape. Cheap (~tens of seconds) and
+deliberately scheduled BEFORE the --conv3 A/B in tools/chip_window.sh: if
+Mosaic rejects the kernel (manual-DMA halo slabs, in-VMEM im2col — first
+compiled here), that verdict must cost seconds, not the A/B budget.
+
+Exits nonzero on a correctness failure.
+    python tools/validate_fused_conv_tpu.py [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+# ResNet50 stride-1 bottleneck conv2 shapes at 224px: (H, W, Cin=Cout=f).
+SHAPES = ((56, 56, 64), (28, 28, 128), (14, 14, 256), (7, 7, 512))
+
+
+def check_shape(batch: int, h: int, w: int, f: int) -> bool:
+    from distributeddeeplearning_tpu.ops import fused_conv_bn as fc
+
+    ks = jax.random.split(jax.random.key(f), 6)
+    x = jax.random.normal(ks[0], (batch, h, w, f), jnp.bfloat16)
+    wk = (jax.random.normal(ks[1], (3, 3, f, f), jnp.float32) * 0.05)
+    mu = x.astype(jnp.float32).mean(axis=(0, 1, 2))
+    inv = jax.lax.rsqrt(x.astype(jnp.float32).var(axis=(0, 1, 2)) + 1e-5)
+    g = jnp.abs(jax.random.normal(ks[2], (f,))) + 0.5
+    b = jax.random.normal(ks[3], (f,)) * 0.1
+    cot = jax.random.normal(ks[4], (3,))
+
+    def scalar(fn):
+        def run(x, mu, inv, g, b, wk):
+            y, s, ss = fn(x, mu, inv, g, b, wk)
+            return (cot[0] * (y.astype(jnp.float32) ** 2).mean()
+                    + cot[1] * s.sum() * 1e-3 + cot[2] * (ss * 1e-3).sum())
+        return run
+
+    fused = scalar(lambda *a: fc.bn_conv3x3_stats(*a, True, True))
+    ref = scalar(lambda *a: fc._twin_fwd(*a[:5], a[5], True, True))
+
+    t0 = time.perf_counter()
+    gf = jax.device_get(jax.jit(jax.grad(fused, argnums=(0, 5)))(
+        x, mu, inv, g, b, wk))
+    compile_s = time.perf_counter() - t0
+    gr = jax.device_get(jax.jit(jax.grad(ref, argnums=(0, 5)))(
+        x, mu, inv, g, b, wk))
+    errs = {}
+    ok = True
+    for name, a_, b_ in (("dx", gf[0], gr[0]), ("dw", gf[1], gr[1])):
+        import numpy as np
+        err = float(np.abs(np.asarray(a_, np.float32)
+                           - np.asarray(b_, np.float32)).max())
+        den = float(np.abs(np.asarray(b_, np.float32)).max()) + 1e-9
+        errs[name] = round(err / den, 5)
+        ok = ok and err / den < 2e-2  # bf16 MXU vs XLA conv rounding
+    # Forward value check too.
+    yk = jax.device_get(jax.jit(
+        lambda *a: fc.bn_conv3x3_stats(*a, True, True))(x, mu, inv, g, b,
+                                                        wk))
+    yr = jax.device_get(jax.jit(
+        lambda *a: fc._twin_fwd(*a, True, True))(x, mu, inv, g, b, wk))
+    import numpy as np
+    yerr = float(np.abs(np.asarray(yk[0], np.float32)
+                        - np.asarray(yr[0], np.float32)).max())
+    errs["y_abs"] = round(yerr, 5)
+    ok = ok and yerr < 0.25  # bf16 ULP at O(10) magnitudes
+
+    # Single-op timing: fused kernel vs bn-apply + XLA conv + stats.
+    fwd_fused = jax.jit(lambda *a: fc.bn_conv3x3_stats(*a, True, True))
+    fwd_ref = jax.jit(lambda *a: fc._twin_fwd(*a, True, True))
+
+    def t(fn):
+        out = fn(x, mu, inv, g, b, wk)
+        jax.device_get(out[1])
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = fn(x, mu, inv, g, b, wk)
+        jax.device_get(out[1])
+        return (time.perf_counter() - t0) / 10
+
+    print(json.dumps({
+        "check": "fused_conv3_validate", "shape": [batch, h, w, f],
+        "ok": ok, "rel_err": errs, "compile_s": round(compile_s, 1),
+        "fused_ms": round(t(fwd_fused) * 1e3, 2),
+        "ref_ms": round(t(fwd_ref) * 1e3, 2)}), flush=True)
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--quick", action="store_true",
+                   help="only the extreme shapes (56x56x64, 7x7x512) — "
+                        "the window-budget Mosaic smoke check")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    shapes = (SHAPES[0], SHAPES[-1]) if args.quick else SHAPES
+    ok = True
+    for h, w, f in shapes:
+        try:
+            ok = check_shape(args.batch, h, w, f) and ok
+        except Exception as e:
+            print(json.dumps({
+                "check": "fused_conv3_validate", "shape": [args.batch, h, w, f],
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

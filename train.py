@@ -68,6 +68,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="conv-epilogue fusion: bottleneck 1x1 convs as "
                         "Pallas matmul+BN (ops/fused_linear_bn.py; "
                         "resnet50/101/152)")
+    p.add_argument("--fused-conv3", action="store_true", default=None,
+                   help="fused_block v2: stride-1 3x3 convs as Pallas "
+                        "conv+BN with bn1-apply prologue and bn2-stats "
+                        "epilogue (ops/fused_conv_bn.py); requires "
+                        "--fused-block")
     p.add_argument("--ema-decay", type=float, default=None,
                    help="exponential-moving-average of params (e.g. "
                         "0.9999); evals score the EMA weights")
@@ -196,6 +201,12 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(fused_bn=True)
     if args.fused_block:
         cfg = cfg.replace(fused_block=True)
+    if args.fused_conv3:
+        if not (args.fused_block or cfg.fused_block):
+            raise SystemExit(
+                "--fused-conv3 requires --fused-block (it extends the "
+                "fused bottleneck's statistics plumbing)")
+        cfg = cfg.replace(fused_conv3=True)
     if args.sync_bn:
         cfg = cfg.replace(sync_bn=True)
     if args.ema_decay is not None:

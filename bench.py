@@ -135,6 +135,8 @@ def _protocol_suffix(args) -> str:
         parts.append("fusedbn")
     if getattr(args, "fused_block", False):
         parts.append("fusedblock")
+    if getattr(args, "fused_conv3", False):
+        parts.append("fusedconv3")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -241,6 +243,7 @@ def _child_measure(args, emit_quick: bool = True,
         remat=args.remat,
         fused_bn=args.fused_bn,
         fused_block=args.fused_block,
+        fused_conv3=getattr(args, "fused_conv3", False),
         parallel=ParallelConfig(data=n_dev),
         data=data)
 
@@ -426,7 +429,7 @@ def _child(args) -> int:
         row = copy.copy(args)
         row.model = model
         row.attention_impl, row.remat, row.fused_bn = None, False, False
-        row.fused_block = False
+        row.fused_block = row.fused_conv3 = False
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -624,6 +627,9 @@ def main(argv=None) -> int:
     p.add_argument("--fused-block", action="store_true",
                    help="conv-epilogue fusion: 1x1 convs as Pallas "
                         "matmul+BN (resnet50/101/152)")
+    p.add_argument("--fused-conv3", action="store_true",
+                   help="fused_block v2: stride-1 3x3 convs as Pallas "
+                        "conv+BN too (requires --fused-block)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -680,6 +686,10 @@ def main(argv=None) -> int:
     p.add_argument("--run-child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
+    if args.fused_conv3 and not args.fused_block:
+        # Same up-front reject as train.py: on a scarce chip window this
+        # must die at parse time, not after backend init inside the child.
+        p.error("--fused-conv3 requires --fused-block")
     try:  # fail a malformed --sweep at parse time, not after the primary
         _sweep_batches(args)
     except ValueError:
@@ -733,6 +743,8 @@ def main(argv=None) -> int:
         child_cmd += ["--fused-bn"]
     if args.fused_block:
         child_cmd += ["--fused-block"]
+    if args.fused_conv3:
+        child_cmd += ["--fused-conv3"]
     if args.suite:
         child_cmd += ["--suite"]
         if args.suite_models:

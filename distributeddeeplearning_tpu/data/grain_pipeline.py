@@ -202,7 +202,17 @@ def build_grain_dataset(config: TrainConfig, *, train: bool,
 
 def make_grain_source(config: TrainConfig, sharding, *, train: bool = True,
                       start_step: int = 0) -> StreamSource:
+    import jax
+
     ds = build_grain_dataset(config, train=train,
                              start_step=start_step if train else 0)
+    hint = None
+    if not train:
+        # Finite val split: this process's slice(pidx, None, pcount) of the
+        # folder index, in full per-process batches (drop_remainder).
+        n_local = len(folder_index(config.data.data_dir, "val")[0]
+                      [jax.process_index()::jax.process_count()])
+        hint = n_local // _per_process_batch(config, jax.process_count())
     return StreamSource(iter(ds), sharding, first_step=start_step,
-                        depth=config.data.prefetch_depth)
+                        depth=config.data.prefetch_depth,
+                        batches_hint=hint)

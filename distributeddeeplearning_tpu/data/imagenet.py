@@ -12,8 +12,8 @@ mapping (SURVEY.md §2 #6, §3.3):
 - batches land in HBM through ``jax.make_array_from_process_local_data`` so
   the resulting global array carries the mesh batch sharding directly —
   no gather, no resharding collective on the hot path;
-- double-buffered device prefetch (data/prefetch.py) overlaps host decode of
-  step k+1 with device compute of step k.
+- double-buffered device prefetch (``StreamSource``'s lookahead buffer,
+  below) overlaps host decode of step k+1 with device compute of step k.
 
 Two on-disk layouts are supported:
 
@@ -143,11 +143,19 @@ def _parse_example(tf, serialized):
     return features["image/encoded"], label
 
 
+@functools.lru_cache(maxsize=8)
 def folder_index(data_dir: str, split: str) -> tuple[list[str], list[int]]:
     """Index a torchvision-style ``<split>/<wnid>/*.JPEG`` tree.
 
     Class ids are assigned by sorted wnid, matching torchvision's
     ``ImageFolder`` convention so checkpoints/evals line up.
+
+    Cached per (dir, split): periodic eval rebuilds its source every
+    invocation (fresh finite stream) and also derives ``batches_hint``
+    from this listing — at ImageNet scale that's two 50k-file directory
+    walks per eval without the cache. Contract: a split's contents are
+    fixed for the life of the process (corpus generation happens before
+    training processes start).
     """
     root = os.path.join(data_dir, split)
     if not os.path.isdir(root):
@@ -269,10 +277,17 @@ class StreamSource:
     _EXHAUSTED = object()
 
     def __init__(self, it: Iterator[dict], sharding, *, first_step: int = 0,
-                 lookahead: bool = True, depth: int = 1):
+                 lookahead: bool = True, depth: int = 1,
+                 batches_hint: Optional[int] = None):
         self._it = it
         self._sharding = sharding
         self._next_step = first_step
+        # How many full local batches this finite stream will yield, when
+        # the builder can know it (imagefolder val splits: file count //
+        # per-process batch). None = unknown. Multi-process eval uses it to
+        # agree on the global batch count with ONE collective up front
+        # instead of a per-batch allgather (ADVICE r4).
+        self.batches_hint = batches_hint
         # depth <= 0 (or lookahead=False) disables prefetch entirely —
         # batches are pulled on demand (used by short bounded evals).
         self._depth = max(depth, 0) if lookahead else 0
@@ -329,6 +344,14 @@ class StreamSource:
 def make_imagenet_source(config: TrainConfig, sharding, *, train: bool = True,
                          start_step: int = 0) -> StreamSource:
     ds = build_dataset(config, train=train, start_step=start_step)
+    hint = None
+    if not train and detect_layout(config.data.data_dir) == "folder":
+        # Finite val split with a listable size: this process's shard is
+        # paths[process_index::process_count] (the ds.shard stride above).
+        n_local = len(folder_index(config.data.data_dir, "val")[0]
+                      [jax.process_index()::jax.process_count()])
+        hint = n_local // _per_process_batch(config, jax.process_count())
     return StreamSource(ds.as_numpy_iterator(), sharding,
                         first_step=start_step,
-                        depth=config.data.prefetch_depth)
+                        depth=config.data.prefetch_depth,
+                        batches_hint=hint)

@@ -211,7 +211,8 @@ def make_native_source(config, sharding, *, train: bool = True,
             return {"image": b["image"].astype(jnp.bfloat16),
                     "label": b["label"]}
         it = (cast(b) for b in it)
-    src = imagenet.StreamSource(it, sharding, first_step=start_step,
-                                depth=d.prefetch_depth)
+    src = imagenet.StreamSource(
+        it, sharding, first_step=start_step, depth=d.prefetch_depth,
+        batches_hint=None if train else len(paths) // per_process)
     src._native_loader = loader  # keep alive; closed on GC
     return src

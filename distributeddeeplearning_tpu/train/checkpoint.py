@@ -119,7 +119,19 @@ class Checkpointer:
         try:
             with open(path) as f:
                 tree_meta = json.load(f)["tree_metadata"]
-        except (OSError, ValueError, KeyError, TypeError):
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # Visible degradation (ADVICE r4): an orbax upgrade that moves
+            # or reshapes this private manifest must not SILENTLY demote
+            # the friendly EMA-flip handling to the strict
+            # structure-mismatch error path.
+            import warnings
+
+            warnings.warn(
+                f"checkpoint manifest {path} unreadable "
+                f"({type(e).__name__}: {e}); EMA-flip detection disabled "
+                f"for this restore — falling back to strict "
+                f"structure-matched restore (did an orbax upgrade change "
+                f"the _METADATA layout?)")
             return None
         for key, entry in tree_meta.items():
             if key.startswith("('ema_params'"):

@@ -10,6 +10,7 @@ framework forks.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import threading
@@ -37,9 +38,26 @@ def _dtype(config: TrainConfig):
 
 def steps_per_epoch(config: TrainConfig) -> Optional[int]:
     """Explicit ``config.steps_per_epoch``, else derived from the dataset's
-    train-split size (ImageNet: 1,281,167), else None (step-based runs)."""
+    train-split size (ImageNet: 1,281,167; an imagefolder ``data_dir``:
+    counted once from disk), else None (step-based runs)."""
     if config.steps_per_epoch:
         return config.steps_per_epoch
+    if config.data.data_dir:
+        # Imagefolder layout (train/<class>/<files>): count the actual
+        # corpus once — it wins over the canonical ImageNet constant, which
+        # is only right for the full dataset (a TFRecord data_dir has
+        # train-* shards, no train/ dir, and falls through). Epoch-cadenced
+        # eval then works on any on-disk corpus (the graded-corpus
+        # convergence leg needs it).
+        train_root = os.path.join(config.data.data_dir, "train")
+        if os.path.isdir(train_root):
+            n = sum(
+                len([e for e in os.scandir(os.path.join(train_root, d))
+                     if e.is_file()])
+                for d in os.listdir(train_root)
+                if os.path.isdir(os.path.join(train_root, d)))
+            if n:
+                return max(n // config.global_batch_size, 1)
     if config.data.dataset == "imagenet":
         from distributeddeeplearning_tpu.data.imagenet import TRAIN_SPLIT_SIZE
         return max(TRAIN_SPLIT_SIZE // config.global_batch_size, 1)
@@ -566,19 +584,58 @@ class _EvaluatorBase:
             # EMA exists); training params continue unaffected.
             state = state.replace(params=state.ema_params)
         source, offset = self._source_and_offset()
+        # Multi-process: the exhaustion decision must be GLOBAL — eval
+        # steps are cross-process collectives, so one process breaking
+        # while another proceeds would deadlock the job. When the source
+        # can size itself up front (``batches_hint`` — the imagefolder val
+        # splits of all three loaders), the processes agree ONCE on
+        # min(local hints) before the loop (ADVICE r4: one collective, not
+        # one per batch); otherwise every iteration carries the per-batch
+        # agreement below.
+        num_batches = self.num_batches
+        per_batch_sync = jax.process_count() > 1
+        if per_batch_sync:
+            hint = getattr(source, "batches_hint", None)
+            if hint is not None:
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                hints = multihost_utils.process_allgather(
+                    np.asarray([hint], np.int64))
+                num_batches = min(num_batches, int(hints.min()))
+                per_batch_sync = False
+                if num_batches < self.num_batches:
+                    import warnings
+
+                    warnings.warn(
+                        f"validation split holds {num_batches} of the "
+                        f"{self.num_batches} requested eval batches; "
+                        f"scoring the available ones")
+                if num_batches == 0:
+                    raise RuntimeError(
+                        f"validation split yields no full batch on some "
+                        f"process (global batch "
+                        f"{self._config.global_batch_size}); shrink the "
+                        f"batch or provide more validation images")
         outs = []
-        for j in range(self.num_batches):
+        for j in range(num_batches):
             try:
                 batch = source.batch(offset + j)
             except StopIteration:
+                if not per_batch_sync and jax.process_count() > 1:
+                    # The upfront agreement promised this batch existed;
+                    # running dry here means the hint was wrong, and a
+                    # silent per-process break would deadlock the
+                    # collective eval step on the others. Die loudly.
+                    raise RuntimeError(
+                        f"eval source exhausted at batch {j} despite "
+                        f"batches_hint promising {num_batches}; the "
+                        f"loader's sharding and its hint disagree")
                 batch = None
-            # Multi-process: the exhaustion decision must be GLOBAL — eval
-            # steps are cross-process collectives, so one process breaking
-            # while another proceeds would deadlock the job. Every process
-            # reaches this agreement point each iteration; if ANY shard ran
+            # Per-batch agreement (unknown-size streams): if ANY shard ran
             # dry (imagefolder files rarely divide evenly), all stop here
             # and the fetched batches of the others are discarded.
-            if jax.process_count() > 1:
+            if per_batch_sync:
                 import numpy as np
                 from jax.experimental import multihost_utils
 

@@ -40,36 +40,59 @@ sys.path.insert(0, REPO)
 import numpy as np
 
 
-def ensure_corpus(root: str, n: int, hw: int) -> None:
-    """n JPEGs, imagefolder layout, 2 classes tinted apart (class0 warm /
-    class1 cool) so a small CNN separates them from pixels in a few dozen
-    steps. Idempotent: a complete corpus is reused (generation on one host
-    core is the slow part; never spend chip-window time on it)."""
-    marker = os.path.join(root, f".complete_{n}_{hw}")
+def ensure_corpus(root: str, n: int, hw: int, classes: int = 16,
+                  alpha: float = 0.22) -> None:
+    """n JPEGs, imagefolder layout, ``classes`` classes with GRADED signal
+    (VERDICT r4 Next #4 — the old 2-class tinted corpus saturated at
+    top-1 = 1.0, proving labels stay attached but nothing about a recipe).
+
+    Class k's signal is a low-amplitude combination a small CNN must
+    average over many pixels to read: a hue tint at angle 2πk/C (adjacent
+    classes 360/C degrees apart — deliberately confusable) plus a
+    sinusoidal texture whose orientation/frequency encode k mod 4 and
+    k // 4. ``alpha`` scales signal vs noise; at the default, eval top-1
+    on a thin ResNet plateaus well below 1.0 while staying far above
+    chance, so a recipe change (LR, schedule, SyncBN) visibly moves it.
+    Idempotent: a complete corpus is reused (generation on one host core
+    is the slow part; never spend chip-window time on it)."""
+    marker = os.path.join(root, f".complete_{n}_{hw}_{classes}_{alpha}")
     if os.path.exists(marker):
         return
     from PIL import Image
 
     rng = np.random.default_rng(0)
     t0 = time.time()
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32) / hw
     for split, count in (("train", n), ("val", max(n // 4, 8))):
         for i in range(count):
-            cls = i % 2
-            d = os.path.join(root, split, f"class{cls}")
+            cls = i % classes
+            d = os.path.join(root, split, f"class{cls:02d}")
             os.makedirs(d, exist_ok=True)
             noise = rng.integers(0, 256, (hw, hw, 3), np.uint8)
-            tint = np.array([170, 90, 60] if cls == 0 else [60, 90, 170],
-                            np.uint8)
-            arr = (noise // 2 + tint // 2).astype(np.uint8)
+            hue = 2 * np.pi * cls / classes
+            tint = np.array([np.cos(hue), np.cos(hue - 2 * np.pi / 3),
+                             np.cos(hue + 2 * np.pi / 3)], np.float32)
+            phi = np.pi * (cls % 4) / 4.0
+            freq = (3, 5, 8, 12)[(cls // 4) % 4]
+            tex = np.sin(2 * np.pi * freq
+                         * (xx * np.cos(phi) + yy * np.sin(phi)))
+            signal = (tint[None, None, :] * 60.0
+                      + tex[:, :, None] * 45.0)
+            arr = np.clip(noise.astype(np.float32) * (1 - alpha)
+                          + (128.0 + signal) * alpha, 0, 255).astype(np.uint8)
             Image.fromarray(arr).save(os.path.join(d, f"img{i}.jpg"),
                                       quality=85)
     open(marker, "w").close()
-    print(f"# corpus: {n} JPEGs @ {hw}px in {time.time() - t0:.0f}s",
+    print(f"# corpus: {n} JPEGs @ {hw}px, {classes} classes "
+          f"(alpha={alpha}) in {time.time() - t0:.0f}s",
           file=sys.stderr, flush=True)
 
 
-def run_leg(leg: str, cli: list[str], timeout: int) -> dict:
-    """One train.py run; returns the parsed summary plus stderr tail."""
+def run_leg(leg: str, cli: list[str], timeout: int,
+            collect_evals: bool = False) -> dict:
+    """One train.py run; returns the parsed summary plus stderr tail.
+    ``collect_evals`` also gathers the periodic-eval JSONL records into a
+    [(step, eval_top1), ...] trajectory (the convergence leg's product)."""
     t0 = time.time()
     try:
         proc = subprocess.run(cli, capture_output=True, text=True,
@@ -79,18 +102,26 @@ def run_leg(leg: str, cli: list[str], timeout: int) -> dict:
                 "stderr": (e.stderr or "")[-400:] if isinstance(
                     e.stderr, str) else None}
     summary = None
+    evals = []
     for line in proc.stdout.splitlines():
         try:
             rec = json.loads(line)
         except ValueError:
             continue
-        if isinstance(rec, dict) and "summary" in rec:
+        if not isinstance(rec, dict):
+            continue
+        if "summary" in rec:
             summary = rec["summary"]
+        elif "eval_top1" in rec:
+            evals.append([rec.get("step"), rec["eval_top1"]])
     if summary is None:
         return {"leg": leg, "error": f"no summary (rc={proc.returncode})",
                 "stderr": proc.stderr[-400:]}
-    return {"leg": leg, "summary": summary,
-            "wall_s": round(time.time() - t0, 1)}
+    out = {"leg": leg, "summary": summary,
+           "wall_s": round(time.time() - t0, 1)}
+    if collect_evals:
+        out["trajectory"] = evals
+    return out
 
 
 def main(argv=None) -> int:
@@ -107,16 +138,32 @@ def main(argv=None) -> int:
     p.add_argument("--eval-batches", type=int, default=4)
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--loaders", default="tf,native,grain")
+    p.add_argument("--classes", type=int, default=16)
+    p.add_argument("--alpha", type=float, default=0.22,
+                   help="corpus signal-to-noise knob (see ensure_corpus)")
+    p.add_argument("--convergence-steps", type=int, default=0,
+                   help="extra CPU-scale leg: train this many steps on the "
+                        "graded corpus via the tf loader with periodic "
+                        "eval, emitting the full eval-top1 trajectory "
+                        "(the non-saturating convergence evidence — "
+                        "VERDICT r4 Next #4). 0 = off")
+    p.add_argument("--convergence-lr", type=float, default=None,
+                   help="LR override for the convergence leg (recipe-"
+                        "sensitivity A/B: run twice with different LRs)")
     p.add_argument("--leg-timeout", type=int, default=600)
     p.add_argument("--keep-checkpoints", action="store_true")
     args = p.parse_args(argv)
 
-    # Size-keyed subdirectory: different --images/--image-size runs must
-    # never share split dirs (a smoke run would otherwise overwrite part of
-    # a larger corpus and every later run would train on a mixed one).
-    args.data_dir = os.path.join(args.data_dir,
-                                 f"{args.images}x{args.image_size}")
-    ensure_corpus(args.data_dir, args.images, args.image_size)
+    # Recipe-keyed subdirectory: different --images/--image-size/--classes/
+    # --alpha runs must never share split dirs (a smoke run would otherwise
+    # overwrite part of a larger corpus, and a stale completeness marker
+    # from one alpha would silently reuse pixels generated at another —
+    # poisoning exactly the SNR A/B the knob exists for).
+    args.data_dir = os.path.join(
+        args.data_dir,
+        f"{args.images}x{args.image_size}x{args.classes}a{args.alpha}")
+    ensure_corpus(args.data_dir, args.images, args.image_size,
+                  args.classes, args.alpha)
     ckroot = tempfile.mkdtemp(prefix="realdata_ck_")
     base = [sys.executable, os.path.join(REPO, "train.py"),
             "--backend", args.backend, "--model", args.model,
@@ -152,6 +199,20 @@ def main(argv=None) -> int:
             more["resume_start_step"] = more["summary"].get("start_step")
         results.append(more)
         print(json.dumps(more), flush=True)
+
+    if args.convergence_steps > 0:
+        # Long leg with periodic eval: the product is the TRAJECTORY (does
+        # top-1 keep rising? where does it plateau?) on the graded corpus
+        # where 1.0 is out of reach — a recipe change moves the plateau.
+        cli = base + ["--data-dir", args.data_dir, "--loader", "tf",
+                      "--steps", str(args.convergence_steps),
+                      "--eval-every-epochs", "0.5"]
+        if args.convergence_lr is not None:
+            cli += ["--lr", str(args.convergence_lr)]
+        conv = run_leg("convergence_tf", cli,
+                       max(args.leg_timeout * 4, 1200), collect_evals=True)
+        results.append(conv)
+        print(json.dumps(conv), flush=True)
 
     if not args.keep_checkpoints:
         shutil.rmtree(ckroot, ignore_errors=True)

@@ -103,6 +103,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="periodic + final held-out eval over N batches "
                         "(top-1 for image models, loss/perplexity for "
                         "token models)")
+    p.add_argument("--eval-every-epochs", type=float, default=None,
+                   help="periodic-eval cadence in epochs (default 1.0; "
+                        "needs --eval-batches and a sized dataset)")
     p.add_argument("--eval-only", action="store_true",
                    help="restore the newest checkpoint and run held-out "
                         "eval without training (requires --checkpoint-dir "
@@ -197,6 +200,11 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(attention_impl=args.attn)
     if args.remat:
         cfg = cfg.replace(remat=True)
+    if args.eval_every_epochs is not None:
+        if args.eval_every_epochs <= 0:
+            raise SystemExit(f"--eval-every-epochs must be positive "
+                             f"(got {args.eval_every_epochs})")
+        cfg = cfg.replace(eval_every_epochs=args.eval_every_epochs)
     if args.fused_bn:
         cfg = cfg.replace(fused_bn=True)
     if args.fused_block:

@@ -191,6 +191,8 @@ def _emit_metric(args, value: float, protocol: str) -> None:
     # parsing the protocol string.
     if getattr(args, "fused_block", False):
         rec["fused_block"] = True
+    if getattr(args, "fused_conv3", False):
+        rec["fused_conv3"] = True
     print(json.dumps(rec), flush=True)
 
 
@@ -385,28 +387,39 @@ def _child(args) -> int:
                 _emit_metric(row, rate,
                              protocol=f"w{row.quick_warmup + row.quick_steps}"
                                       f"+{row.steps} b{alt} sweep")
-        # Conv-epilogue fusion alternate (--fused-block path, round-3/4
-        # kernel campaign): measured at the winning batch, emitted ONLY if
-        # strictly faster — so the driver's own headline run captures a
-        # fusion win the moment there is one, and stays silent otherwise.
-        # Restricted to the headline protocol like the batch sweep.
+        # Conv-epilogue fusion alternates (round-3/5 kernel campaign):
+        # measured at the winning batch, emitted ONLY if strictly faster —
+        # so the driver's own headline run captures a fusion win the
+        # moment there is one, and stays silent otherwise. v2
+        # (fused_conv3, the 3x3 kernel) runs only if v1 succeeded — a
+        # Mosaic rejection of the new kernel must cost one caught
+        # exception, never the headline. Restricted to the headline
+        # protocol like the batch sweep.
         if (args.model == "resnet50" and args.batch_size == 512
                 and not args.fused_block and args.sweep == "auto"):
-            row = copy.copy(args)
-            row.batch_size, row.fused_block = best_batch, True
-            try:
-                rate = _child_measure(row, emit_quick=False,
-                                      emit_final=False)
-                _note(f"fused-block b{best_batch}: {rate:.1f}/chip "
+            for label, flags in (
+                    ("fused-block", {"fused_block": True}),
+                    ("fused-conv3", {"fused_block": True,
+                                     "fused_conv3": True})):
+                row = copy.copy(args)
+                row.batch_size = best_batch
+                for k, v in flags.items():
+                    setattr(row, k, v)
+                try:
+                    rate = _child_measure(row, emit_quick=False,
+                                          emit_final=False)
+                except Exception as e:
+                    _note(f"{label} alternate failed: "
+                          f"{type(e).__name__}: {e}")
+                    break  # v2 builds on v1; don't try it after a failure
+                _note(f"{label} b{best_batch}: {rate:.1f}/chip "
                       f"(best {best:.1f})")
                 if rate > best:
+                    best = rate
                     _emit_metric(
                         row, rate,
                         protocol=f"w{row.quick_warmup + row.quick_steps}"
                                  f"+{row.steps} b{best_batch} sweep")
-            except Exception as e:
-                _note(f"fused-block alternate failed: "
-                      f"{type(e).__name__}: {e}")
         return 0
     wanted = (set(args.suite_models.split(","))
               if args.suite_models else None)

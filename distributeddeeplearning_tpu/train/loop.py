@@ -10,7 +10,6 @@ framework forks.
 
 from __future__ import annotations
 
-import os
 import signal
 import sys
 import threading
@@ -44,20 +43,20 @@ def steps_per_epoch(config: TrainConfig) -> Optional[int]:
         return config.steps_per_epoch
     if config.data.data_dir:
         # Imagefolder layout (train/<class>/<files>): count the actual
-        # corpus once — it wins over the canonical ImageNet constant, which
-        # is only right for the full dataset (a TFRecord data_dir has
-        # train-* shards, no train/ dir, and falls through). Epoch-cadenced
-        # eval then works on any on-disk corpus (the graded-corpus
-        # convergence leg needs it).
-        train_root = os.path.join(config.data.data_dir, "train")
-        if os.path.isdir(train_root):
-            n = sum(
-                len([e for e in os.scandir(os.path.join(train_root, d))
-                     if e.is_file()])
-                for d in os.listdir(train_root)
-                if os.path.isdir(os.path.join(train_root, d)))
-            if n:
-                return max(n // config.global_batch_size, 1)
+        # corpus — it wins over the canonical ImageNet constant, which is
+        # only right for the full dataset (a TFRecord data_dir has train-*
+        # shards, no train/ dir, and raises here). folder_index is the
+        # loaders' own lru_cached, extension-filtered listing, so the
+        # derived epoch length agrees with the batches they yield and the
+        # walk is shared, not repeated. Epoch-cadenced eval then works on
+        # any on-disk corpus (the graded-corpus convergence leg needs it).
+        try:
+            from distributeddeeplearning_tpu.data.imagenet import (
+                folder_index)
+            n = len(folder_index(config.data.data_dir, "train")[0])
+            return max(n // config.global_batch_size, 1)
+        except FileNotFoundError:
+            pass
     if config.data.dataset == "imagenet":
         from distributeddeeplearning_tpu.data.imagenet import TRAIN_SPLIT_SIZE
         return max(TRAIN_SPLIT_SIZE // config.global_batch_size, 1)

@@ -154,6 +154,43 @@ def test_fused_block_alternate_emits_only_if_faster(bench, monkeypatch,
             assert out[-1]["value"] == fused_rate  # last line wins
 
 
+def test_fused_conv3_alternate_chains_after_v1(bench, monkeypatch, capsys):
+    """The headline run tries v1 (fused_block) then v2 (fused_conv3);
+    each emits only on a strict win over the running best, and a v2
+    failure (e.g. Mosaic rejection on-chip) costs one caught exception,
+    never the headline or the v1 result."""
+    def run(rates, conv3_raises=False):
+        def fake_measure(row, emit_quick=True, emit_final=True,
+                         deadline=None):
+            key = ("conv3" if getattr(row, "fused_conv3", False)
+                   else "v1" if row.fused_block
+                   else row.batch_size)
+            if key == "conv3" and conv3_raises:
+                raise RuntimeError("mosaic says no")
+            rate = rates[key]
+            if emit_final:
+                bench._emit_metric(row, rate, protocol=f"b{row.batch_size}")
+            return rate
+
+        monkeypatch.setattr(bench, "_child_measure", fake_measure)
+        args = _args(bench, ["--model", "resnet50"])  # sweep auto
+        _run_child_with_fake_jax(bench, args)
+        return [json.loads(line) for line in
+                capsys.readouterr().out.strip().splitlines()]
+
+    # v2 beats v1 beats baseline: three lines, last one is v2.
+    out = run({512: 100.0, 256: 90.0, "v1": 110.0, "conv3": 120.0})
+    assert [r["value"] for r in out] == [100.0, 110.0, 120.0]
+    assert "fusedconv3" in out[-1]["protocol"]
+    # v2 slower than v1: v1's line stands as the last.
+    out = run({512: 100.0, 256: 90.0, "v1": 110.0, "conv3": 105.0})
+    assert [r["value"] for r in out] == [100.0, 110.0]
+    # v2 raises: v1's win survives, no error record pollutes stdout.
+    out = run({512: 100.0, 256: 90.0, "v1": 110.0, "conv3": 0.0},
+              conv3_raises=True)
+    assert [r["value"] for r in out] == [100.0, 110.0]
+
+
 def test_preflight_kills_hung_backend_fast(bench):
     # A child that never prints the backend-up heartbeat models a down
     # tunnel (jax.devices() hangs). The attempt must die at the preflight

@@ -229,6 +229,53 @@ def test_multihost_gspmd_axis_spans_processes(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_eval_uses_upfront_batch_agreement(tmp_path):
+    """Multi-process eval over a REAL finite imagefolder split: the
+    processes must agree on the global eval batch count via the upfront
+    ``batches_hint`` collective (ADVICE r4) — and when the split holds
+    fewer batches than requested, eval scores what exists on every
+    process instead of deadlocking the collective eval step."""
+    import json
+
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for split, count in (("train", 64), ("val", 24)):
+        for i in range(count):
+            cls = i % 2
+            d = tmp_path / "data" / split / f"class{cls}"
+            d.mkdir(parents=True, exist_ok=True)
+            arr = rng.integers(0, 256, (32, 32, 3)).astype(np.uint8)
+            arr[:, :, 0] = 200 if cls == 0 else 30
+            Image.fromarray(arr).save(d / f"img{i}.jpg")
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["XLA_FLAGS"] = ""  # 1 CPU device per process -> dp=2 spans procs
+    env["JAX_PLATFORMS"] = "cpu"
+    # global batch 8 -> per-process val shard 12 images = 3 full local
+    # batches of 4; ask for 5 eval batches so the hint must clamp to 3.
+    cmd = [sys.executable, "train.py", "--backend", "cpu", "--model",
+           "resnet18_thin", "--batch-size", "8", "--dp", "2",
+           "--data-dir", str(tmp_path / "data"), "--loader", "tf",
+           "--dtype", "float32", "--steps", "4", "--eval-batches", "5",
+           "--image-size", "32", "--log-every", "1000000"]
+    proc = subprocess.run(
+        [sys.executable, "launch.py", "--num-processes", "2",
+         "--port", "9412", "--"] + cmd,
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if "summary" in ln]
+    assert lines, proc.stderr[-2000:]
+    summary = json.loads(lines[-1])["summary"]
+    assert summary["final_step"] == 4
+    # The final eval ran over the 3 available batches (clamped from 5).
+    assert summary["eval_top1"] is not None
+    assert "holds 3 of the 5 requested" in proc.stderr
+
+
+@pytest.mark.slow
 def test_max_restarts_auto_resumes(tmp_path):
     """--max-restarts closes the §5.3 loop in-launcher: the injected crash
     triggers an automatic relaunch that resumes from the checkpoint and

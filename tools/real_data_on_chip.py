@@ -169,6 +169,10 @@ def main(argv=None) -> int:
             "--backend", args.backend, "--model", args.model,
             "--batch-size", str(args.batch_size),
             "--eval-batches", str(args.eval_batches),
+            # Decode/train at the corpus's own resolution — without this
+            # every leg silently upscales to the 224 default (a 64px
+            # corpus then pays ~12x the conv FLOPs for zero information).
+            "--image-size", str(args.image_size),
             "--log-every", "25"]
     if args.backend == "cpu":
         base += ["--dtype", "float32"]

@@ -37,6 +37,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--synthetic", action="store_true", default=None,
                    help="on-device synthetic data (config 1)")
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="decode/augment target side length for image "
+                        "pipelines (default 224; small-corpus runs avoid "
+                        "upscaling cost by matching their JPEG size)")
     p.add_argument("--loader", default=None,
                    choices=["auto", "tf", "native", "grain"],
                    help="input pipeline for image datasets")
@@ -241,6 +245,11 @@ def build_config(args: argparse.Namespace):
         data_updates["synthetic"] = False
     if args.loader:
         data_updates["loader"] = args.loader
+    if args.image_size is not None:
+        if args.image_size <= 0:
+            raise SystemExit(
+                f"--image-size must be positive (got {args.image_size})")
+        data_updates["image_size"] = args.image_size
     if data_updates:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **data_updates))
 

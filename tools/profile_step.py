@@ -51,6 +51,7 @@ def run_and_trace(args, log_dir: str) -> None:
         model=args.model, global_batch_size=args.batch_size * n_dev,
         dtype="bfloat16", log_every=10**9, fused_bn=args.fused_bn,
         fused_block=args.fused_block,
+        fused_conv3=getattr(args, "fused_conv3", False),
         attention_impl=args.attention_impl, remat=args.remat,
         parallel=ParallelConfig(data=n_dev), data=data)
     mesh, model, batch_shd, state, train_step, sched, rng = loop.build(
@@ -71,7 +72,13 @@ def run_and_trace(args, log_dir: str) -> None:
 
 
 FAMILIES = (
-    # (family, compiled regex over the slice name) — first match wins.
+    # (family, compiled regex over slice name + HLO metadata) — first
+    # match wins. conv_matmul outranks the reduce/elementwise families
+    # because an XLA *fusion* slice whose metadata mentions a convolution
+    # or dot is MXU work with fused epilogues, not an elementwise pass —
+    # classifying those by the bare "fusion"/"convert_reduce" slice name
+    # is exactly how the round-2 profile undercounted conv time
+    # (BASELINE.md's MFU-correction note).
     ("pallas", re.compile(r"custom-call|pallas|tpu_custom_call")),
     ("conv_matmul", re.compile(
         r"convolution|conv_general|dot_general|dot\b|matmul|cudnn|mxu")),
@@ -83,8 +90,12 @@ FAMILIES = (
 )
 
 
-def classify(name: str) -> str:
-    low = name.lower()
+def classify(name: str, meta: str = "") -> str:
+    """Family for a trace slice. ``meta`` is the stringified event args —
+    jax's perfetto traces carry the HLO long name / source expression
+    there, which reveals what a generically-named fusion actually
+    computes."""
+    low = f"{name} {meta}".lower()
     for fam, pat in FAMILIES:
         if pat.search(low):
             return fam
@@ -121,17 +132,21 @@ def summarize(log_dir: str, steps: int, top: int):
     op_keys = {key for key, name in tid_names.items()
                if key[0] in device_pids and "op" in name.lower()}
     per_op = collections.Counter()
+    op_meta: dict = {}
     for ev in events:
         if ev.get("ph") != "X" or (ev.get("pid"), ev.get("tid")) not in op_keys:
             continue
-        per_op[ev.get("name", "?")] += ev.get("dur", 0)  # microseconds
+        name = ev.get("name", "?")
+        per_op[name] += ev.get("dur", 0)  # microseconds
+        if name not in op_meta and ev.get("args"):
+            op_meta[name] = " ".join(str(v) for v in ev["args"].values())
     if not per_op:  # fall back: no recognized op track
         for ev in events:
             if ev.get("ph") == "X":
                 per_op[ev.get("name", "?")] += ev.get("dur", 0)
     fam = collections.Counter()
     for name, us in per_op.items():
-        fam[classify(name)] += us
+        fam[classify(name, op_meta.get(name, ""))] += us
     total_ms = sum(per_op.values()) / 1000 / steps
     return {
         "device_ms_per_step": round(total_ms, 2),
@@ -152,6 +167,7 @@ def main(argv=None) -> int:
     p.add_argument("--remat", action="store_true")
     p.add_argument("--fused-bn", action="store_true")
     p.add_argument("--fused-block", action="store_true")
+    p.add_argument("--fused-conv3", action="store_true")
     p.add_argument("--warmup", type=int, default=4)
     p.add_argument("--steps", type=int, default=6)
     p.add_argument("--top", type=int, default=25)
@@ -167,6 +183,32 @@ def main(argv=None) -> int:
     out["batch_per_chip"] = args.batch_size
     out["fused_bn"] = args.fused_bn
     out["fused_block"] = args.fused_block
+    out["fused_conv3"] = args.fused_conv3
+    # Analytic-MFU cross-check against DEVICE-BUSY time (not wall):
+    # by_family_ms should roughly partition this much useful work.
+    try:
+        from distributeddeeplearning_tpu.config import (
+            resolve_mlm_max_predictions)
+        from distributeddeeplearning_tpu.models import flops as flopslib
+        from distributeddeeplearning_tpu.models import model_spec
+        spec = model_spec(args.model)
+        mlm = (resolve_mlm_max_predictions(-1, args.seq_len,
+                                           spec.objective)
+               if spec.input_kind == "tokens" else 0)
+        per_ex = flopslib.train_flops_per_example(
+            args.model, seq_len=args.seq_len, mlm_positions=mlm)
+        if per_ex and len(out.get("device_tracks", [])) == 1:
+            busy_s = out["device_ms_per_step"] / 1e3
+            tflops = args.batch_size * per_ex / busy_s / 1e12
+            out["busy_tflops_per_sec"] = round(tflops, 2)
+            import jax
+            peak = flopslib.bf16_peak_flops(
+                jax.devices()[0].device_kind)
+            if peak:
+                out["busy_mfu_pct"] = round(
+                    100.0 * tflops * 1e12 / peak, 1)
+    except Exception:
+        pass
     out["wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(out), flush=True)
     return 0

@@ -34,7 +34,18 @@ cd "$(dirname "$0")/.."
 RES="$(realpath -m "${1:-.chip_results}")"  # absolute: survives the cd above
 mkdir -p "$RES"
 stamp() { date +%H:%M:%S; }
-note() { rc=$?; echo "[$(stamp)] $1 rc=$rc" >> "$RES/log.txt"; }
+# Per-step (name, rc, wall seconds) into timings.jsonl — the measured P50s
+# the NEXT session's budgets should be set from (this round's are
+# estimates; VERDICT r4 Weak #1 asked for measured ones).
+STEP_T0=$(date +%s)
+note() {
+  rc=$?
+  local now; now=$(date +%s)
+  echo "[$(stamp)] $1 rc=$rc ${2:-}" >> "$RES/log.txt"
+  echo "{\"step\": \"$1\", \"rc\": $rc, \"wall_s\": $((now - STEP_T0))}" \
+    >> "$RES/timings.jsonl"
+  STEP_T0=$now
+}
 
 echo "[$(stamp)] window open" >> "$RES/log.txt"
 

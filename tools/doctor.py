@@ -65,6 +65,39 @@ def check_cpu_mesh() -> None:
         emit("virtual_cpu_mesh", ok=False, error=str(e)[:200])
 
 
+def check_kernels() -> None:
+    """Interpret-mode smoke of every Pallas kernel family on tiny shapes —
+    an import error or interpret regression in any of them should show up
+    in one doctor run, not at bench time on a scarce chip window."""
+    code = """
+import os
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax, jax.numpy as jnp
+from distributeddeeplearning_tpu.ops.flash_attention import flash_attention
+from distributeddeeplearning_tpu.ops.fused_linear_bn import linear_stats
+from distributeddeeplearning_tpu.ops.fused_conv_bn import conv3x3_stats
+from distributeddeeplearning_tpu.ops.embedding import embedding_lookup
+q = jax.random.normal(jax.random.key(0), (1, 16, 2, 8))
+flash_attention(q, q, q)
+x = jax.random.normal(jax.random.key(1), (32, 8))
+linear_stats(x, jax.random.normal(jax.random.key(2), (8, 16)))
+img = jax.random.normal(jax.random.key(3), (1, 8, 8, 8))
+conv3x3_stats(img, jax.random.normal(jax.random.key(4), (3, 3, 8, 8)))
+embedding_lookup(x, jnp.array([[0, 3]]))
+print('OK')
+"""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300)
+        emit("pallas_kernels_interpret",
+             ok=r.stdout.strip().endswith("OK"),
+             **({} if r.returncode == 0 else
+                {"error": r.stderr[-300:]}))
+    except Exception as e:
+        emit("pallas_kernels_interpret", ok=False, error=str(e)[:200])
+
+
 def check_versions() -> None:
     import importlib.metadata as md
     vers = {}
@@ -138,6 +171,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     check_accelerator(args.probe_timeout)
     check_cpu_mesh()
+    check_kernels()
     check_versions()
     check_native()
     check_loader()

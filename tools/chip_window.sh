@@ -1,6 +1,13 @@
 #!/bin/bash
 # Run every pending on-chip measurement in VALUE-PER-MINUTE order, one log
-# per step. Usage: tools/chip_window.sh [results_dir]  (default .chip_results)
+# per step.
+# Usage: tools/chip_window.sh [results_dir] [hard_stop_epoch_s]
+#   results_dir       default .chip_results
+#   hard_stop_epoch_s absolute wall deadline (date +%s) after which no NEW
+#                     step beyond the headline+A/B prefix starts — so a
+#                     window opening near the watcher's deadline can never
+#                     leave this script contending with the driver's own
+#                     end-of-round bench run. Empty = no stop.
 #
 # Window economics (VERDICT r4 Weak #1): the only tunnel window ever
 # observed was ~25 minutes (2026-07-31, ~03:47-04:10 UTC), so the priority
@@ -32,8 +39,16 @@
 set -u
 cd "$(dirname "$0")/.."
 RES="$(realpath -m "${1:-.chip_results}")"  # absolute: survives the cd above
+HARD_STOP="${2:-}"
 mkdir -p "$RES"
 stamp() { date +%H:%M:%S; }
+check_stop() {
+  if [ -n "$HARD_STOP" ] && [ "$(date +%s)" -ge "$HARD_STOP" ]; then
+    echo "[$(stamp)] hard stop before step $1 (driver's chip time)" \
+      >> "$RES/log.txt"
+    exit 0
+  fi
+}
 # Per-step (name, rc, wall seconds) into timings.jsonl — the measured P50s
 # the NEXT session's budgets should be set from (this round's are
 # estimates; VERDICT r4 Weak #1 asked for measured ones).
@@ -65,6 +80,7 @@ timeout 480 python tools/ab_fused_block.py --batches 512 \
   > "$RES/fused_block_ab.json" 2>> "$RES/log.txt"
 note fused_block
 
+check_stop suite_top
 # 3. Highest-value suite rows under an explicit row budget: SUITE rows
 # 0-3 = resnet50 (acceptance row, cache hot from step 1), BERT-512 flash,
 # gpt2, BERT-512 dense (gather-head protocol, never measured on chip).
@@ -74,6 +90,7 @@ timeout 540 python bench.py --suite --budget 520 --suite-rows 0,1,2,3 \
   > "$RES/bench_suite_top.json" 2>> "$RES/log.txt"
 note suite_top
 
+check_stop real_data_tf
 # 4. Real-pixels end-to-end, tf.data loader: disk JPEGs -> decode ->
 # device_put -> train -> eval on the real chip — the loader/train overlap
 # number (corpus pre-generated under .cache/real_jpegs; never spend window
@@ -83,6 +100,7 @@ timeout 520 python tools/real_data_on_chip.py --steps 100 --loaders tf \
   --leg-timeout 150 > "$RES/real_data_tf.json" 2>> "$RES/log.txt"
 note real_data_tf
 
+check_stop profile
 # 5. Profile the fused-block step (where does its time go — reads on the
 # A/B either way it lands). P50 ~2 min warm.
 timeout 300 python tools/profile_step.py --model resnet50 --batch-size 512 \
@@ -92,6 +110,7 @@ echo "[$(stamp)] priority prefix done" >> "$RES/log.txt"
 
 # --- Extended batch: runs only while the window stays open ----------------
 
+check_stop fused_conv3
 # 5b. Fused 3x3 conv kernel (fused_block v2): FIRST compiled-Mosaic smoke
 # at the extreme shapes — a rejection must cost seconds here, not the A/B
 # below. Then the three-way step A/B (unfused / v1 / v2).
@@ -102,6 +121,7 @@ timeout 700 python tools/ab_fused_block.py --batches 512 --conv3 \
   > "$RES/fused_conv3_ab.json" 2>> "$RES/log.txt"
 note fused_conv3_ab
 
+check_stop suite_rest
 # 6. Remaining suite rows: SUITE rows 4-7 = resnet152, densenet121,
 # vit_b16, bert-2048 flash+remat (exact-row selection — a model-name
 # filter would re-admit the bert rows step 3 already measured).
@@ -109,6 +129,7 @@ timeout 900 python bench.py --suite --budget 860 --suite-rows 4,5,6,7 \
   > "$RES/bench_suite_rest.json" 2>> "$RES/log.txt"
 note suite_rest
 
+check_stop real_data
 # 7. Remaining real-data legs: native C++ loader + grain only (tf was
 # step 4; re-running it would spend window time on duplicates). 5 legs
 # (synthetic baseline + 2 loaders + 2 resumes) x 180s + slack.
@@ -117,6 +138,7 @@ timeout 1100 python tools/real_data_on_chip.py --steps 100 \
   > "$RES/real_data.json" 2>> "$RES/log.txt"
 note real_data
 
+check_stop matmul_micro
 # 8. Pallas matmul vs XLA dot at ResNet 1x1 shapes (kernel derisk data).
 timeout 420 python - > "$RES/matmul_micro.json" 2>> "$RES/log.txt" <<'EOF'
 import json, sys, time
@@ -152,6 +174,7 @@ for m, k, n in ((802816, 64, 256), (200704, 128, 512), (50176, 256, 1024),
 EOF
 note matmul_micro
 
+check_stop xla_sweep
 # 9. XLA-flag sweep on the headline config (quick protocol): any free wins
 # from scheduler/memory knobs the default compile doesn't enable. The jax
 # compilation cache keys on the flags, so cached default executables don't
@@ -167,12 +190,14 @@ for flags in \
   note "xla_$tag"
 done
 
+check_stop decode
 # 10. Decode throughput (serving-side): GPT-2 KV-cache vs refeed.
 timeout 600 python tools/bench_generate.py --model gpt2_small --batch 8 \
   --prompt-len 128 --new-tokens 128 > "$RES/decode_throughput.json" \
   2>> "$RES/log.txt"
 note decode
 
+check_stop flash
 # 11. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
 timeout 600 python tools/validate_flash_tpu.py \
   > "$RES/flash_validate.json" 2>> "$RES/log.txt"

@@ -21,8 +21,14 @@ while :; do
   fi
   if timeout 45 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
       >> "$LOG" 2>&1; then
-    echo "[$(date +%H:%M:%S)] TUNNEL UP — launching chip_window.sh" >> "$LOG"
-    nohup bash tools/chip_window.sh >> "$LOG" 2>&1 &
+    # Hard stop for the window script's extended batch: 30 min past this
+    # watcher's own deadline — a window opening late still lands the
+    # headline+A/B prefix but can never contend with the driver's
+    # end-of-round bench for the chip.
+    STOP=$((START + DEADLINE + 1800))
+    echo "[$(date +%H:%M:%S)] TUNNEL UP — launching chip_window.sh" \
+         "(hard stop $STOP)" >> "$LOG"
+    nohup bash tools/chip_window.sh .chip_results "$STOP" >> "$LOG" 2>&1 &
     exit 0
   fi
   sleep 90

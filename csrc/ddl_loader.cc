@@ -56,59 +56,117 @@ struct Image {
   bool ok() const { return h > 0 && w > 0; }
 };
 
-// Decode with DCT scaling: libjpeg can decode at 1/2, 1/4, 1/8 resolution
-// almost for free; pick the largest reduction that keeps both sides >=
-// min_side (preserves crop/resize quality while cutting IDCT work — the
-// cheap half of DALI's fused decode-and-crop trick). The caller picks
-// min_side so the smallest crop it will take is never upsampled.
-Image decode_jpeg(const uint8_t* buf, size_t len, int min_side) {
-  Image img;
+struct Crop {
+  int y, x, h, w;
+};
+
+// Fused decode-and-crop (both halves of DALI's trick, round 5):
+//
+// The header is parsed once; ``pick`` draws the crop from the FULL-image
+// dimensions (so the crop never depends on decode scaling); then only
+// the crop's region is decoded:
+//
+// 1. DCT scaling — decode at 1/2, 1/4, 1/8 resolution, chosen so the
+//    SCALED CROP (not a worst-case crop bound) still covers ``target``
+//    in both axes: knowing the crop up front lets the typical
+//    20-60%-area crop take a deeper reduction than a global bound could.
+//    Eval callers pass target = 2x the bilinear side to keep the
+//    long-standing 2x decode-resolution margin (ADVICE r1 #3).
+// 2. Region decode (libjpeg-turbo only) — jpeg_crop_scanline restricts
+//    IDCT to the crop's horizontal band (widened to iMCU boundaries) and
+//    jpeg_skip_scanlines skips rows above it; rows below are never read.
+//    Plain IJG libjpeg (no LIBJPEG_TURBO_VERSION) falls back to a full
+//    scaled-frame decode with identical pixels — just more IDCT work.
+//
+// Versioning note: crops were previously drawn on the DCT-scaled decoded
+// dims; drawing on full header dims changes the realized deterministic
+// stream versus round-4 builds for images large enough that scaling
+// engaged (shorter side >= ~919px at 224 target). Within a build the
+// stream remains a pure function of (seed, position).
+template <typename PickCrop>
+bool decode_jpeg_cropped(const uint8_t* buf, size_t len, int target,
+                         const PickCrop& pick, Image* img, Crop* local) {
   jpeg_decompress_struct cinfo;
   JpegErr err;
   cinfo.err = jpeg_std_error(&err.mgr);
   err.mgr.error_exit = jpeg_error_exit;
   if (setjmp(err.jump)) {
     jpeg_destroy_decompress(&cinfo);
-    return Image{};
+    return false;
   }
   jpeg_create_decompress(&cinfo);
   jpeg_mem_src(&cinfo, buf, len);
   jpeg_read_header(&cinfo, TRUE);
+  const Crop crop = pick((int)cinfo.image_height, (int)cinfo.image_width);
   cinfo.out_color_space = JCS_RGB;
   cinfo.dct_method = JDCT_IFAST;
   cinfo.scale_num = 1;
   cinfo.scale_denom = 1;
-  if (min_side > 0) {
+  if (target > 0) {
     while (cinfo.scale_denom < 8 &&
-           (int)cinfo.image_width / (int)(cinfo.scale_denom * 2) >= min_side &&
-           (int)cinfo.image_height / (int)(cinfo.scale_denom * 2) >= min_side) {
+           crop.w / (int)(cinfo.scale_denom * 2) >= target &&
+           crop.h / (int)(cinfo.scale_denom * 2) >= target) {
       cinfo.scale_denom *= 2;
     }
   }
   jpeg_start_decompress(&cinfo);
   if (cinfo.output_components != 3) {  // JCS_RGB should guarantee 3
     jpeg_destroy_decompress(&cinfo);
-    return Image{};
+    return false;
   }
-  img.w = cinfo.output_width;
-  img.h = cinfo.output_height;
-  img.rgb.resize((size_t)img.h * img.w * 3);
-  while (cinfo.output_scanline < cinfo.output_height) {
-    uint8_t* row = img.rgb.data() + (size_t)cinfo.output_scanline * img.w * 3;
-    jpeg_read_scanlines(&cinfo, &row, 1);
+  const int denom = (int)cinfo.scale_denom;
+  const int out_w = (int)cinfo.output_width;
+  const int out_h = (int)cinfo.output_height;
+  // Crop rectangle in scaled coordinates (floor start / ceil end keeps
+  // the region a superset of the exact scaled crop).
+  int sx = std::clamp(crop.x / denom, 0, out_w - 1);
+  int sy = std::clamp(crop.y / denom, 0, out_h - 1);
+  int ex = std::clamp((crop.x + crop.w + denom - 1) / denom, sx + 1, out_w);
+  int ey = std::clamp((crop.y + crop.h + denom - 1) / denom, sy + 1, out_h);
+#ifdef LIBJPEG_TURBO_VERSION
+  JDIMENSION xoff = (JDIMENSION)sx;
+  JDIMENSION xw = (JDIMENSION)(ex - sx);
+  // Turbo widens the band to iMCU boundaries: xoff may move left and xw
+  // may grow; the local crop x below accounts for the shift.
+  jpeg_crop_scanline(&cinfo, &xoff, &xw);
+  if (sy > 0 && (int)jpeg_skip_scanlines(&cinfo, (JDIMENSION)sy) != sy) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
   }
-  jpeg_finish_decompress(&cinfo);
+  const int row_w = (int)xw;
+  const int rows = ey - sy;
+  local->x = sx - (int)xoff;
+  local->y = 0;
+#else
+  // IJG fallback: decode the full scaled frame; the crop is a plain
+  // sub-rectangle of it.
+  const int row_w = out_w;
+  const int rows = out_h;
+  local->x = sx;
+  local->y = sy;
+#endif
+  img->w = row_w;
+  img->h = rows;
+  img->rgb.resize((size_t)rows * row_w * 3);
+  for (int r = 0; r < rows;) {
+    uint8_t* row = img->rgb.data() + (size_t)r * row_w * 3;
+    JDIMENSION got = jpeg_read_scanlines(&cinfo, &row, 1);
+    if (got == 0) {  // truncated stream
+      jpeg_destroy_decompress(&cinfo);
+      return false;
+    }
+    r += (int)got;
+  }
+  // Any rows below the crop band are never decoded; destroy aborts.
   jpeg_destroy_decompress(&cinfo);
-  return img;
+  local->w = ex - sx;
+  local->h = ey - sy;
+  return img->ok();
 }
 
 // ---------------------------------------------------------------------------
 // Crop + bilinear resize + normalize
 // ---------------------------------------------------------------------------
-
-struct Crop {
-  int y, x, h, w;
-};
 
 // tf.image.sample_distorted_bounding_box-style random area crop.
 Crop random_resized_crop(std::mt19937_64& rng, int h, int w) {
@@ -246,7 +304,15 @@ struct DdlLoader {
     float* out = slot.images.data() + (size_t)slot_off * image_size * image_size * 3;
     slot.labels[slot_off] = s.label;
 
+    // Fused decode-and-crop: the crop is drawn from the header dims
+    // inside decode_jpeg_cropped's single parse, then only its region is
+    // decoded at the deepest DCT scale that keeps it >= the target in
+    // both axes (no upsampling softening the augmentation distribution —
+    // ADVICE r1 #3). Eval keeps its long-standing 2x decode-resolution
+    // margin via the doubled target.
     Image img;
+    Crop local{};
+    bool hflip = false;
     {
       FILE* f = std::fopen(s.path.c_str(), "rb");
       if (f) {
@@ -255,15 +321,22 @@ struct DdlLoader {
         std::fseek(f, 0, SEEK_SET);
         std::vector<uint8_t> buf((size_t)std::max(len, 0L));
         if (len > 0 && std::fread(buf.data(), 1, (size_t)len, f) == (size_t)len) {
-          // Train: the smallest random-resized crop is 8% area at 4:3
-          // aspect, i.e. a shorter side of sqrt(0.08/(4/3)) ~= 0.245x the
-          // image — bound DCT scaling so even that crop decodes at >=
-          // target resolution (no upsampling softening the augmentation
-          // distribution — ADVICE r1 #3). Eval center-crops ~0.875x, so
-          // 2*target keeps its long-standing margin.
-          int min_side = train ? (int)std::ceil(image_size / 0.244f)
-                               : 2 * image_size;
-          img = decode_jpeg(buf.data(), buf.size(), min_side);
+          // Augmentation RNG keyed by (seed, pos): reproducible per
+          // sample. hflip is drawn AFTER the crop, matching the old
+          // draw order.
+          std::mt19937_64 rng(
+              seed ^ (0xda3e39cb94b95bdbULL * (uint64_t)(pos + 1)));
+          auto pick = [&](int fh, int fw) {
+            Crop c = train ? random_resized_crop(rng, fh, fw)
+                           : center_crop(fh, fw, image_size);
+            if (train) hflip = (rng() & 1) != 0;
+            return c;
+          };
+          int target = train ? image_size : 2 * image_size;
+          if (!decode_jpeg_cropped(buf.data(), buf.size(), target, pick,
+                                   &img, &local)) {
+            img = Image{};
+          }
         }
         std::fclose(f);
       }
@@ -276,18 +349,7 @@ struct DdlLoader {
           out[i * 3 + ch] = (128.0f - mean[ch]) / stdev[ch];
       return;
     }
-
-    Crop crop;
-    bool hflip = false;
-    if (train) {
-      // Augmentation RNG keyed by (seed, pos): reproducible per sample.
-      std::mt19937_64 rng(seed ^ (0xda3e39cb94b95bdbULL * (uint64_t)(pos + 1)));
-      crop = random_resized_crop(rng, img.h, img.w);
-      hflip = (rng() & 1) != 0;
-    } else {
-      crop = center_crop(img.h, img.w, image_size);
-    }
-    resize_bilinear(img, crop, image_size, out, hflip);
+    resize_bilinear(img, local, image_size, out, hflip);
     for (size_t i = 0; i < (size_t)image_size * image_size; ++i)
       for (int ch = 0; ch < 3; ++ch) {
         float& v = out[i * 3 + ch];

@@ -62,31 +62,43 @@ BACKEND_UP_HEARTBEAT = "backend up:"
 COMPILE_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".cache", "jax_compile")
 
-# --suite rows: (model, overrides, est_s) in VALUE-PER-MINUTE order — a
-# window that dies mid-suite yields the most valuable prefix (VERDICT r4
-# Weak #5). est_s is the expected on-chip wall cost of the row (compile
-# with warm persistent cache + measure; round-2/3 sessions measured
-# ~30-60s compile + ~60s measure per row) and gates row admission against
-# the remaining --suite-budget; it is NOT a hard per-row kill (the row
-# deadline handles that). Batch sizes are the measured sweet spots from
+# --suite rows: (name, model, overrides, est_s) in VALUE-PER-MINUTE order —
+# a window that dies mid-suite yields the most valuable prefix (VERDICT r4
+# Weak #5). Rows are SELECTED BY NAME (--suite-rows, tools/chip_window.sh):
+# names are stable under reorders/insertions, unlike the former positional
+# indices where adding a row silently shifted which configs each window
+# step measured (ADVICE r5). est_s is the expected on-chip wall cost of the
+# row (compile with warm persistent cache + measure; round-2/3 sessions
+# measured ~30-60s compile + ~60s measure per row) and gates row admission
+# against the remaining --suite-budget; it is NOT a hard per-row kill (the
+# row deadline handles that). Batch sizes are the measured sweet spots from
 # BASELINE.md's round-2 sweeps; S=2048 rows need flash+remat to fit.
 SUITE = (
     # Headline family first: its compile cache is warm from the headline
     # run, and the acceptance metric of record is this row.
-    ("resnet50", {}, 90),
+    ("resnet50", "resnet50", {}, 90),
+    # Fused-vs-per-leaf gradient all-reduce A/B (parallel/collectives.py):
+    # same model/batch as the headline (warm cache), differing ONLY in the
+    # reduction schedule — ar_fused buckets leaves at the default 4 MB,
+    # ar_perleaf (bucket_mb=0) reduces leaf-by-leaf, the pre-fusion
+    # behavior. Never measured on chip — the tensor-fusion win this PR
+    # exists to quantify.
+    ("ar_fused", "resnet50", {"allreduce_bucket_mb": 4.0}, 90),
+    ("ar_perleaf", "resnet50", {"allreduce_bucket_mb": 0.0}, 90),
     # Never measured on chip under the gather-head protocol (r2 protocol
     # change) — the two highest-value unknown rows.
-    ("bert_base", {"batch_size": 32, "seq_len": 512,
-                   "attention_impl": "flash"}, 120),
-    ("gpt2_small", {"batch_size": 16, "seq_len": 1024}, 120),
-    ("bert_base", {"batch_size": 32, "seq_len": 512}, 120),
-    ("resnet152", {"batch_size": 256}, 120),
-    ("densenet121", {"batch_size": 256}, 120),
-    ("vit_b16", {"batch_size": 256}, 120),
+    ("bert512_flash", "bert_base", {"batch_size": 32, "seq_len": 512,
+                                    "attention_impl": "flash"}, 120),
+    ("gpt2_1024", "gpt2_small", {"batch_size": 16, "seq_len": 1024}, 120),
+    ("bert512", "bert_base", {"batch_size": 32, "seq_len": 512}, 120),
+    ("resnet152", "resnet152", {"batch_size": 256}, 120),
+    ("densenet121", "densenet121", {"batch_size": 256}, 120),
+    ("vit_b16", "vit_b16", {"batch_size": 256}, 120),
     # Long-context last: largest compile, slowest steps, and its CPU-side
     # evidence (flash==dense parity) is the strongest of the set.
-    ("bert_base", {"batch_size": 32, "seq_len": 2048,
-                   "attention_impl": "flash", "remat": True}, 180),
+    ("bert2048_flash", "bert_base", {"batch_size": 32, "seq_len": 2048,
+                                     "attention_impl": "flash",
+                                     "remat": True}, 180),
 )
 
 
@@ -115,10 +127,17 @@ def _metric_name_unit(args) -> tuple[str, str]:
             resolve_mlm_max_predictions)
         mp = resolve_mlm_max_predictions(
             args.mlm_max_predictions, args.seq_len, objective)
+    # Per-leaf gradient all-reduce (bucket_mb=0) is the fusion A/B's
+    # reference schedule, NOT the production path: give it its own metric
+    # name so its (expected-slower) number can never evict the headline's
+    # last-good entry under the same key.
+    perleaf = ("_perleaf_ar"
+               if getattr(args, "allreduce_bucket_mb", None) == 0 else "")
+    if objective:
         gather = f"_g{mp}" if mp > 0 else ""
-        return (f"{args.model}_{objective}_s{args.seq_len}{gather}"
+        return (f"{args.model}{perleaf}_{objective}_s{args.seq_len}{gather}"
                 f"_seqs_per_sec_per_chip", "sequences/sec/chip")
-    return (f"{args.model}_imagenet_images_per_sec_per_chip",
+    return (f"{args.model}{perleaf}_imagenet_images_per_sec_per_chip",
             "images/sec/chip")
 
 
@@ -137,6 +156,14 @@ def _protocol_suffix(args) -> str:
         parts.append("fusedblock")
     if getattr(args, "fused_conv3", False):
         parts.append("fusedconv3")
+    ar_mb = getattr(args, "allreduce_bucket_mb", None)
+    if ar_mb is not None:
+        # Reduction schedule is protocol: default (no flag) is the fused
+        # path at AllReduceConfig's default bucket size; an explicit value
+        # is marked so the A/B rows stay distinguishable in the record.
+        parts.append("perleaf-ar" if ar_mb == 0 else f"ar{ar_mb:g}mb")
+    if getattr(args, "allreduce_dtype", None) == "bfloat16":
+        parts.append("ar-bf16")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -224,7 +251,8 @@ def _child_measure(args, emit_quick: bool = True,
 
     from distributeddeeplearning_tpu import data as datalib
     from distributeddeeplearning_tpu.config import (
-        DataConfig, ParallelConfig, TrainConfig, resolve_mlm_max_predictions)
+        AllReduceConfig, DataConfig, ParallelConfig, TrainConfig,
+        resolve_mlm_max_predictions)
     from distributeddeeplearning_tpu.models import model_spec
     from distributeddeeplearning_tpu.train import loop
 
@@ -236,6 +264,11 @@ def _child_measure(args, emit_quick: bool = True,
     data = (DataConfig(synthetic=True, dataset="mlm", seq_len=args.seq_len,
                        mlm_max_predictions=mlm_pred)
             if tokens else DataConfig(synthetic=True))
+    ar_kw = {}
+    if getattr(args, "allreduce_bucket_mb", None) is not None:
+        ar_kw["bucket_mb"] = args.allreduce_bucket_mb
+    if getattr(args, "allreduce_dtype", None):
+        ar_kw["dtype"] = args.allreduce_dtype
     cfg = TrainConfig(
         model=args.model,
         global_batch_size=args.batch_size * n_dev,
@@ -247,7 +280,8 @@ def _child_measure(args, emit_quick: bool = True,
         fused_block=args.fused_block,
         fused_conv3=getattr(args, "fused_conv3", False),
         parallel=ParallelConfig(data=n_dev),
-        data=data)
+        data=data,
+        allreduce=AllReduceConfig(**ar_kw))
 
     quick_w = (args.warmup_steps if args.warmup_steps is not None
                else args.quick_warmup)
@@ -423,7 +457,7 @@ def _child(args) -> int:
         return 0
     wanted = (set(args.suite_models.split(","))
               if args.suite_models else None)
-    wanted_rows = (set(int(i) for i in args.suite_rows.split(","))
+    wanted_rows = (set(args.suite_rows.split(","))
                    if args.suite_rows else None)
     # Suite budget discipline (VERDICT r4 Weak #5): rows run in SUITE's
     # value-per-minute order against one deadline anchored at backend-up.
@@ -434,15 +468,16 @@ def _child(args) -> int:
     # of eating the rows behind it. Skips are visible on stderr.
     suite_deadline = (time.monotonic() + args.suite_budget
                       if args.suite_budget > 0 else None)
-    for row_i, (model, overrides, est_s) in enumerate(SUITE):
+    for row_name, model, overrides, est_s in SUITE:
         if wanted is not None and model not in wanted:
             continue
-        if wanted_rows is not None and row_i not in wanted_rows:
+        if wanted_rows is not None and row_name not in wanted_rows:
             continue
         row = copy.copy(args)
         row.model = model
         row.attention_impl, row.remat, row.fused_bn = None, False, False
         row.fused_block = row.fused_conv3 = False
+        row.allreduce_bucket_mb = row.allreduce_dtype = None
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -643,6 +678,16 @@ def main(argv=None) -> int:
     p.add_argument("--fused-conv3", action="store_true",
                    help="fused_block v2: stride-1 3x3 convs as Pallas "
                         "conv+BN too (requires --fused-block)")
+    p.add_argument("--allreduce-bucket-mb", type=float, default=None,
+                   help="gradient tensor-fusion bucket size in MB "
+                        "(parallel/collectives.py); 0 = per-leaf reduction "
+                        "(the unfused A/B reference, emitted under its own "
+                        "_perleaf_ar metric name); unset = config default "
+                        "(fused, 4 MB)")
+    p.add_argument("--allreduce-dtype", default=None,
+                   choices=[None, "float32", "bfloat16"],
+                   help="gradient all-reduce payload dtype (bfloat16 = "
+                        "compressed wire payload, fp32 restored after)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -660,12 +705,13 @@ def main(argv=None) -> int:
                    help="with --suite: only measure rows whose model is "
                         "in this comma list (re-run a single row)")
     p.add_argument("--suite-rows", default=None,
-                   help="with --suite: only measure rows at these indices "
-                        "into SUITE (comma list, 0-based, value-per-minute "
-                        "order) — unlike --suite-models this selects "
-                        "EXACT rows, e.g. one of the bert_base protocol "
-                        "variants (tools/chip_window.sh splits the suite "
-                        "across window steps with this)")
+                   help="with --suite: only measure rows with these NAMES "
+                        "(comma list, see SUITE; runs in suite order) — "
+                        "unlike --suite-models this selects EXACT rows, "
+                        "e.g. one of the bert_base protocol variants "
+                        "(tools/chip_window.sh splits the suite across "
+                        "window steps with this); names stay valid when "
+                        "rows are inserted or reordered")
     p.add_argument("--suite", action="store_true",
                    help="measure every acceptance config, one line each")
     p.add_argument("--suite-budget", type=int, default=-1,
@@ -703,6 +749,10 @@ def main(argv=None) -> int:
         # Same up-front reject as train.py: on a scarce chip window this
         # must die at parse time, not after backend init inside the child.
         p.error("--fused-conv3 requires --fused-block")
+    if args.allreduce_bucket_mb is not None and args.allreduce_bucket_mb < 0:
+        p.error(f"--allreduce-bucket-mb must be >= 0 "
+                f"(got {args.allreduce_bucket_mb}); 0 selects per-leaf "
+                f"reduction")
     try:  # fail a malformed --sweep at parse time, not after the primary
         _sweep_batches(args)
     except ValueError:
@@ -712,7 +762,7 @@ def main(argv=None) -> int:
         p.error("--sweep is a headline-run option; suite rows pin their "
                 "measured sweet-spot batches (see SUITE)")
     if args.suite_models:
-        known = {m for m, _o, _e in SUITE}
+        known = {m for _n, m, _o, _e in SUITE}
         asked = {s.strip() for s in args.suite_models.split(",") if s.strip()}
         if not asked or asked - known:
             p.error(f"--suite-models: unknown model(s) "
@@ -723,14 +773,14 @@ def main(argv=None) -> int:
         if args.suite_models:
             p.error("--suite-rows and --suite-models are mutually "
                     "exclusive (rows select exact entries)")
-        try:
-            rows = sorted({int(i) for i in args.suite_rows.split(",")})
-        except ValueError:
-            p.error(f"--suite-rows {args.suite_rows!r}: expected a comma "
-                    f"list of ints")
-        if not rows or rows[0] < 0 or rows[-1] >= len(SUITE):
-            p.error(f"--suite-rows: indices must be in [0, {len(SUITE)-1}]")
-        args.suite_rows = ",".join(str(i) for i in rows)
+        names = {n for n, _m, _o, _e in SUITE}
+        asked = [s.strip() for s in args.suite_rows.split(",") if s.strip()]
+        unknown = [s for s in asked if s not in names]
+        if not asked or unknown:
+            p.error(f"--suite-rows: unknown row name(s) "
+                    f"{unknown or args.suite_rows!r}; suite rows: "
+                    f"{[n for n, _m, _o, _e in SUITE]}")
+        args.suite_rows = ",".join(dict.fromkeys(asked))  # dedupe, keep order
 
     if args.run_child:
         return _child(args)
@@ -758,6 +808,10 @@ def main(argv=None) -> int:
         child_cmd += ["--fused-block"]
     if args.fused_conv3:
         child_cmd += ["--fused-conv3"]
+    if args.allreduce_bucket_mb is not None:
+        child_cmd += ["--allreduce-bucket-mb", str(args.allreduce_bucket_mb)]
+    if args.allreduce_dtype:
+        child_cmd += ["--allreduce-dtype", args.allreduce_dtype]
     if args.suite:
         child_cmd += ["--suite"]
         if args.suite_models:

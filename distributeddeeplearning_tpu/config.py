@@ -51,6 +51,31 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass
+class AllReduceConfig:
+    """Gradient all-reduce policy for the explicit-DP path (shard_map).
+
+    Horovod-style tensor fusion (parallel/collectives.py): gradient leaves
+    are packed into size-targeted buckets and reduced with ONE collective
+    per bucket instead of one per parameter tensor, so XLA can overlap the
+    early buckets' reductions with the tail of the backward pass.
+    """
+
+    bucket_mb: float = 4.0        # fusion-buffer target size; 0 = per-leaf
+                                  # reduction (the unfused A/B baseline)
+    dtype: str = "float32"        # reduction payload: float32 (grads' own
+                                  # dtype) | bfloat16 (half the wire bytes;
+                                  # fp32 masters restored after the reduce)
+    algorithm: str = "psum"       # psum (one all-reduce) | ring
+                                  # (psum_scatter + all_gather, the
+                                  # bandwidth-optimal two-phase form)
+
+    def describe(self) -> str:
+        mode = (f"fused bucket_mb={self.bucket_mb:g}" if self.bucket_mb > 0
+                else "per-leaf")
+        return f"{mode} dtype={self.dtype} algo={self.algorithm}"
+
+
+@dataclasses.dataclass
 class DataConfig:
     """Input pipeline settings (SURVEY.md §2 #5/#6)."""
 
@@ -153,6 +178,8 @@ class TrainConfig:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    allreduce: AllReduceConfig = dataclasses.field(
+        default_factory=AllReduceConfig)
 
     @property
     def per_device_batch(self) -> int:

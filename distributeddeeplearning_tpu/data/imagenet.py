@@ -144,7 +144,8 @@ def _parse_example(tf, serialized):
 
 
 @functools.lru_cache(maxsize=8)
-def folder_index(data_dir: str, split: str) -> tuple[list[str], list[int]]:
+def folder_index(data_dir: str,
+                 split: str) -> tuple[tuple[str, ...], tuple[int, ...]]:
     """Index a torchvision-style ``<split>/<wnid>/*.JPEG`` tree.
 
     Class ids are assigned by sorted wnid, matching torchvision's
@@ -155,7 +156,9 @@ def folder_index(data_dir: str, split: str) -> tuple[list[str], list[int]]:
     from this listing — at ImageNet scale that's two 50k-file directory
     walks per eval without the cache. Contract: a split's contents are
     fixed for the life of the process (corpus generation happens before
-    training processes start).
+    training processes start). Returns tuples: every consumer aliases the
+    cache entry, so the index must be immutable — a list mutated through
+    one alias would silently corrupt every later epoch and consumer.
     """
     root = os.path.join(data_dir, split)
     if not os.path.isdir(root):
@@ -171,7 +174,7 @@ def folder_index(data_dir: str, split: str) -> tuple[list[str], list[int]]:
                 labels.append(idx)
     if not paths:
         raise FileNotFoundError(f"image-folder split {root!r} has no JPEGs")
-    return paths, labels
+    return tuple(paths), tuple(labels)
 
 
 def detect_layout(data_dir: str) -> str:

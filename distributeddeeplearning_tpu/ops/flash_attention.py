@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributeddeeplearning_tpu import compat
 from distributeddeeplearning_tpu.ops.masks import block_causal_mask
 
 _NEG = -1e30
@@ -193,7 +194,7 @@ def _fwd(q, k, v, mask, seed, *, scale, block_q, block_k, interpret, causal,
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, mask[:, None, :], seed)
@@ -342,7 +343,7 @@ def _bwd(scale, block_q, block_k, interpret, causal, dropout_rate,
         out_specs=[q_tile],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, mask3, g, lse3, delta3, seed)[0]
@@ -364,7 +365,7 @@ def _bwd(scale, block_q, block_k, interpret, causal, dropout_rate,
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, mask3, g, lse3, delta3, seed)
@@ -469,7 +470,7 @@ def flash_attention_sharded(q, k, v, kv_mask=None, *,
     """
     from jax.sharding import PartitionSpec as P
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return flash_attention(q, k, v, kv_mask,
                                dropout_rate=dropout_rate,
@@ -499,10 +500,10 @@ def flash_attention_sharded(q, k, v, kv_mask=None, *,
                                dropout_rate=dropout_rate,
                                dropout_seed=seed1[0], bh_offsets=offs, **kw)
 
-    # check_vma=False: pallas_call's out_shape carries no varying-axes info;
-    # the body is pure per-shard compute (no collectives), so the check adds
-    # nothing here.
-    return jax.shard_map(
+    # compat.shard_map runs check-off: pallas_call's out_shape carries no
+    # varying-axes info; the body is pure per-shard compute (no
+    # collectives), so the check adds nothing here.
+    return compat.shard_map(
         fn, in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axes, None),
                       P(None)),
-        out_specs=qkv_spec, check_vma=False)(q, k, v, kv_mask, seed_arr)
+        out_specs=qkv_spec)(q, k, v, kv_mask, seed_arr)

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributeddeeplearning_tpu import compat
+
 
 def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -53,7 +55,7 @@ def _struct(shape, dtype, like):
     under shard_map with check_vma (the explicit-collective DP train step),
     pallas_call outputs must declare how they vary across mesh axes — they
     vary exactly as the activations they are computed from."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = getattr(compat.typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -68,8 +70,8 @@ def _match_vma(ct, primal):
     per-shard contributions — exactly the psum that shard_map's AD inserts
     when transposing the implicit broadcast in the unfused composition.
     Outside shard_map both vma sets are empty and this is the identity."""
-    ct_vma = getattr(jax.typeof(ct), "vma", None) or frozenset()
-    primal_vma = getattr(jax.typeof(primal), "vma", None) or frozenset()
+    ct_vma = getattr(compat.typeof(ct), "vma", None) or frozenset()
+    primal_vma = getattr(compat.typeof(primal), "vma", None) or frozenset()
     extra = tuple(sorted(ct_vma - primal_vma))
     if extra:
         ct = jax.lax.psum(ct, extra)
@@ -135,7 +137,7 @@ def _jnp_twin(x) -> bool:
     boundary types matter — see :func:`_struct`), so on hardware the
     kernels always run."""
     return (_should_interpret()
-            and bool(getattr(jax.typeof(x), "vma", None)))
+            and bool(getattr(compat.typeof(x), "vma", None)))
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributeddeeplearning_tpu import compat
 from distributeddeeplearning_tpu.ops.fused_batchnorm import (
     _jnp_twin, _match_vma, _should_interpret, _struct, _tile)
 
@@ -183,6 +184,11 @@ def _fwd(x, mu, inv, gamma, beta, w, relu, bn,
                         pltpu.SemaphoreType.DMA((3,)),
                         pltpu.VMEM((1, cout), jnp.float32),
                         pltpu.VMEM((1, cout), jnp.float32)],
+        # The stats scratch accumulates ACROSS grid cells (zeroed at cell 0,
+        # flushed at the last): pin every grid axis sequential so a future
+        # parallel/megacore default can't silently split the accumulator.
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interp,
     )(x, w2r, mu[None], inv[None], gamma[None], beta[None])
     return y, s[0], ss[0]
@@ -274,6 +280,9 @@ def _bwd_dx(dy, y, ds, dss, w, x, mu, inv, gamma, beta, relu, bn,
                         pltpu.SemaphoreType.DMA((3,)),
                         pltpu.VMEM((1, cin), jnp.float32),
                         pltpu.VMEM((1, cin), jnp.float32)],
+        # db/dg scratch accumulates across grid cells — sequential grid only.
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interp,
     )(dy, y, ds[None], dss[None], wf, x, mu[None], inv[None],
       gamma[None], beta[None])
@@ -335,6 +344,10 @@ def _bwd_dw(x, mu, inv, gamma, beta, dy, y, ds, dss, relu, bn,
         scratch_shapes=[pltpu.VMEM((th + 2, ww + 2, cin), x.dtype),
                         pltpu.SemaphoreType.DMA((3,)),
                         pltpu.VMEM((9 * cin, tn), jnp.float32)],
+        # The dw accumulator carries across the (nb, nh) axes per cout tile
+        # — sequential grid only.
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interp,
     )(x, mu[None], inv[None], gamma[None], beta[None], dy, y,
       ds[None], dss[None])

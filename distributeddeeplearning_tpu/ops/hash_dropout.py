@@ -30,6 +30,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from distributeddeeplearning_tpu import compat
+
 # Odd 32-bit constants for the coordinate combine (golden-ratio family) and
 # the murmur3 finalizer multipliers.
 _C_ROW = 0x9E3779B9
@@ -84,9 +86,9 @@ def shard_bh_offsets(batch_axes, head_axis: str, b_local: int,
 
     b_idx = jnp.int32(0)
     for ax in batch_axes:
-        b_idx = b_idx * lax.axis_size(ax) + lax.axis_index(ax)
+        b_idx = b_idx * compat.axis_size(ax) + lax.axis_index(ax)
     return (b_idx * b_local, lax.axis_index(head_axis) * h_local,
-            h_local * lax.axis_size(head_axis))
+            h_local * compat.axis_size(head_axis))
 
 
 def seed_from_key(key):

@@ -5,6 +5,12 @@ collectives are emitted by XLA from ``shard_map``/``jit`` sharding
 annotations and ride ICI/DCN; rendezvous is ``jax.distributed``.
 """
 
+from distributeddeeplearning_tpu.parallel.collectives import (  # noqa: F401
+    BucketPlan,
+    all_reduce,
+    all_reduce_gradients,
+    plan_buckets,
+)
 from distributeddeeplearning_tpu.parallel.mesh import (  # noqa: F401
     MESH_AXES,
     make_mesh,

@@ -125,10 +125,13 @@ def use_mesh(mesh: Mesh):
     """Ambient-mesh context manager, across jax API renames.
 
     Needed so ``with_sharding_constraint``/flax logical constraints can
-    resolve bare PartitionSpecs during tracing.
+    resolve bare PartitionSpecs during tracing. Newest name first; on JAX
+    generations predating both ``use_mesh`` and ``set_mesh`` the Mesh object
+    itself is the context manager that installs the thread-resources env.
     """
-    setter = getattr(jax.sharding, "use_mesh", None) or jax.sharding.set_mesh
-    return setter(mesh)
+    setter = (getattr(jax.sharding, "use_mesh", None)
+              or getattr(jax.sharding, "set_mesh", None))
+    return setter(mesh) if setter is not None else mesh
 
 
 def local_mesh_description(mesh: Mesh) -> str:

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distributeddeeplearning_tpu import compat
 from distributeddeeplearning_tpu.ops.masks import block_causal_mask
 
 # Large-negative instead of -inf: keeps exp() exactly 0 without inf-inf NaN
@@ -106,7 +107,7 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
     """
     b, sq, h, d = q.shape
     scale = d ** -0.5
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     m = jnp.full((b, h, sq), _NEG, jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
     acc = jnp.zeros((b, h, sq, d), jnp.float32)
@@ -190,7 +191,7 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
         raise ValueError("ring_attention_sharded: dropout_rate > 0 needs "
                          "a dropout_seed")
     if mesh is None:
-        ambient = jax.sharding.get_abstract_mesh()
+        ambient = compat.get_abstract_mesh()
         if ambient is None or ambient.empty:
             # No mesh context (single-device apply / notebook use): one local
             # block is the whole ring. Zigzag over one shard with identity
@@ -228,7 +229,7 @@ def ring_attention_sharded(q, k, v, kv_mask, *,
         return ring_attention(qs, ks, vs, ms, axis_name=seq_axis,
                               causal=causal, dropout=drop)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         fn, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, P(None)),
         out_specs=qkv_spec)
@@ -298,7 +299,7 @@ def zigzag_ring_attention(q, k, v, kv_mask, *, axis_name: str = "seq",
     b, sl, h, d = q.shape
     c = sl // 2
     scale = d ** -0.5
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     kv_mask = kv_mask.astype(jnp.bool_)
 

@@ -294,9 +294,11 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
 
     if jax.process_index() == 0:
         # stderr so harness consumers (bench.py) keep a clean stdout
+        ar = ("" if uses_gspmd(config, spec.input_kind)
+              else f" | allreduce: {config.allreduce.describe()}")
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
-              f"dtype={config.dtype} loader={resolved_loader}"
+              f"dtype={config.dtype} loader={resolved_loader}" + ar
               + (f" | resumed@{start_step}" if start_step else ""),
               file=sys.stderr, flush=True)
 

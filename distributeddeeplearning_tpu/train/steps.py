@@ -5,13 +5,14 @@ Two parallel execution styles, both single XLA programs per step
 jax.pmap/pjit emitting XLA psum over ICI"):
 
 1. ``make_dp_train_step`` — ``shard_map`` over the (data, fsdp) mesh axes
-   with replicated parameters and an explicit ``lax.pmean`` on gradients.
-   This is the literal Horovod-semantics path for the CNN configs: local
-   BatchNorm (per-shard statistics, like per-GPU BN under Horovod), gradient
-   averaging across shards, identical parameter update everywhere. The
-   backward-hook + background-thread + fusion-buffer machinery of Horovod's
-   C++ core collapses into XLA scheduling fused all-reduces over ICI
-   (SURVEY.md §3.1).
+   with replicated parameters and an explicit bucketed all-reduce on
+   gradients (parallel/collectives.py). This is the literal
+   Horovod-semantics path for the CNN configs: local BatchNorm (per-shard
+   statistics, like per-GPU BN under Horovod), gradient averaging across
+   shards, identical parameter update everywhere. Horovod's backward-hook +
+   background-thread + fusion-buffer machinery maps onto the bucket planner:
+   leaves fuse into size-targeted buckets, one collective each, which XLA
+   overlaps with the remaining backward compute (SURVEY.md §3.1).
 
 2. ``make_gspmd_train_step`` — ``jit`` + ``NamedSharding`` with logical-axis
    rules (parallel/sharding.py). Used for transformer workloads where
@@ -32,7 +33,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributeddeeplearning_tpu import compat
 from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.parallel import collectives
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel.mesh import use_mesh
 from distributeddeeplearning_tpu.train import losses
@@ -164,7 +167,8 @@ def accumulated_grads(loss_fn, params, batch_stats, batch, rng, accum: int,
         # Under shard_map's varying-manual-axes check the replicated input
         # stats are unvarying while updated stats (computed from the sharded
         # batch) vary over the DP axes — the scan carry must enter varying.
-        batch_stats = jax.lax.pvary(batch_stats, vary_axes)
+        # (compat.shard_map runs with the check off, where this is identity.)
+        batch_stats = compat.pvary(batch_stats, vary_axes)
 
     def body(carry, xs):
         grads_acc, bn = carry
@@ -196,9 +200,11 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     """Build the jitted data-parallel train step.
 
     state: fully replicated. batch: leading dim sharded over (data, fsdp).
-    Gradients (and BN running-stat updates) are ``pmean``-ed over the DP axes
-    — the exact allreduce-average Horovod performs — so parameters stay
-    bit-identical on every shard.
+    Per-shard gradients are summed across the DP axes by the bucketed fused
+    all-reduce (``config.allreduce``: bucket size / payload dtype /
+    psum-vs-ring) and divided by the shard count — the exact
+    allreduce-average Horovod performs — so parameters stay bit-identical
+    on every shard. BN running-stat updates are ``pmean``-ed likewise.
     """
     loss_fn = loss_fn_for(model, input_kind, config, objective)
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
@@ -216,11 +222,16 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             loss_fn, state.params, state.batch_stats, batch, rng, accum,
             vary_axes=DATA_AXES)
 
-        # The allreduce: params enter replicated (in_spec P()), so shard_map's
-        # autodiff transpose has ALREADY psummed the per-shard gradients over
-        # ICI (the sum is required for `grads` to be replicated, which
-        # check_vma enforces). Dividing by the shard count turns the Horovod
+        # The allreduce. compat.shard_map runs with replication checking OFF,
+        # so autodiff does NOT auto-psum gradients for the replicated params
+        # — `grads` arrives here shard-LOCAL, and this train step owns the
+        # reduction schedule: leaves fuse into size-targeted buckets, one
+        # collective per bucket (Horovod tensor fusion), with each bucket an
+        # independent dataflow edge XLA can overlap with remaining backward
+        # compute. Dividing the sum by the shard count turns the
         # ring-allreduce-sum into the gradient *average* hvd applies.
+        grads = collectives.all_reduce_gradients(
+            grads, DATA_AXES, axis_size=dp_size, options=config.allreduce)
         grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
         metrics = jax.lax.pmean(metrics, DATA_AXES)
         if new_bn is not None:
@@ -238,7 +249,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         return new_state, metrics
 
     batch_spec = P(DATA_AXES)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         step_fn, mesh=mesh,
         in_specs=(P(), batch_spec, P()),
         out_specs=(P(), P()))
@@ -306,7 +317,7 @@ def make_dp_eval_step(model, mesh: Mesh, config: TrainConfig):
         total = jax.lax.psum(total, DATA_AXES)
         return {"correct": correct, "total": total}
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         eval_fn, mesh=mesh, in_specs=(P(), P(DATA_AXES)),
         out_specs=P())
     return jax.jit(mapped)

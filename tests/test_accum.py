@@ -88,6 +88,7 @@ def test_lars_32k_preset_runs_on_8_devices():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_accum_gspmd_tokens_runs():
     from distributeddeeplearning_tpu.train import loop
 

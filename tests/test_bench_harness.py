@@ -93,13 +93,13 @@ def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     rc = _run_child_with_fake_jax(bench, args)
     assert rc == 0
     models = [s[0] for s in seen]
-    # SUITE's value-per-minute order: resnet50, bert flash, (gpt2 filtered
-    # out), bert dense, (resnet152 filtered), densenet121, (vit filtered),
-    # bert 2048.
-    assert models == ["resnet50", "bert_base", "bert_base", "densenet121",
-                      "bert_base"]
+    # SUITE's value-per-minute order: resnet50 + the two allreduce A/B
+    # rows (also resnet50), bert flash, (gpt2 filtered out), bert dense,
+    # (resnet152 filtered), densenet121, (vit filtered), bert 2048.
+    assert models == ["resnet50", "resnet50", "resnet50", "bert_base",
+                      "bert_base", "densenet121", "bert_base"]
     # Suite rows must NOT inherit headline flags; row overrides apply.
-    assert all(s[3] is False for s in seen[:2])  # remat reset
+    assert all(s[3] is False for s in seen[:3])  # remat reset
     out = [json.loads(line) for line in
            capsys.readouterr().out.strip().splitlines()]
     errors = [r for r in out if r.get("value") is None]
@@ -270,8 +270,9 @@ def test_suite_budget_skips_and_admits_rows(bench, monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_child_measure", fake_measure)
     monkeypatch.setattr(bench, "SUITE", (
-        ("resnet50", {}, 10_000),          # can't fit: skip + note
-        ("gpt2_small", {"batch_size": 16, "seq_len": 1024}, 1),  # fits
+        ("resnet50", "resnet50", {}, 10_000),  # can't fit: skip + note
+        ("gpt2_1024", "gpt2_small",
+         {"batch_size": 16, "seq_len": 1024}, 1),  # fits
     ))
     args = _args(bench, ["--suite", "--suite-budget", "5"])
 
@@ -288,7 +289,7 @@ def test_suite_budget_skips_and_admits_rows(bench, monkeypatch, capsys):
 
 
 def test_suite_rows_selects_exact_rows(bench, monkeypatch, capsys):
-    """--suite-rows picks SUITE entries by index — the only way to select
+    """--suite-rows picks SUITE entries by NAME — the only way to select
     one bert_base protocol variant (tools/chip_window.sh splits the suite
     across window steps with it)."""
     seen = []
@@ -298,35 +299,40 @@ def test_suite_rows_selects_exact_rows(bench, monkeypatch, capsys):
         return 1.0
 
     monkeypatch.setattr(bench, "_child_measure", fake_measure)
-    args = _args(bench, ["--suite", "--suite-rows", "1,7"])
+    args = _args(bench, ["--suite", "--suite-rows",
+                         "bert512_flash,bert2048_flash"])
     _run_child_with_fake_jax(bench, args)
     assert seen == [("bert_base", "flash", 512),
                     ("bert_base", "flash", 2048)]
 
 
 def test_suite_order_contract_for_chip_window(bench):
-    """tools/chip_window.sh steps 3 and 6 hard-code --suite-rows 0,1,2,3 /
-    4,5,6,7 against this exact ordering; reorder SUITE and you must update
-    the script (and this pin)."""
-    key = [(m, o.get("attention_impl"), o.get("seq_len"))
-           for m, o, _e in bench.SUITE]
-    assert key == [
-        ("resnet50", None, None),
-        ("bert_base", "flash", 512),
-        ("gpt2_small", None, 1024),
-        ("bert_base", None, 512),
-        ("resnet152", None, None),
-        ("densenet121", None, None),
-        ("vit_b16", None, None),
-        ("bert_base", "flash", 2048),
+    """tools/chip_window.sh selects rows by these NAMES (suite_top /
+    suite_rest / allreduce_ab steps); renaming a row breaks the script, so
+    this pin and the script must move in lockstep. Order still matters for
+    budget gating (value-per-minute prefix), so it is pinned too."""
+    names = [n for n, _m, _o, _e in bench.SUITE]
+    assert names == [
+        "resnet50", "ar_fused", "ar_perleaf", "bert512_flash", "gpt2_1024",
+        "bert512", "resnet152", "densenet121", "vit_b16", "bert2048_flash",
     ]
+    key = {n: (m, o.get("attention_impl"), o.get("seq_len"),
+               o.get("allreduce_bucket_mb"))
+           for n, m, o, _e in bench.SUITE}
+    assert key["resnet50"] == ("resnet50", None, None, None)
+    assert key["ar_fused"] == ("resnet50", None, None, 4.0)
+    assert key["ar_perleaf"] == ("resnet50", None, None, 0.0)
+    assert key["bert512_flash"] == ("bert_base", "flash", 512, None)
+    assert key["bert2048_flash"] == ("bert_base", "flash", 2048, None)
 
 
 def test_suite_rows_validation(bench, capsys):
     with pytest.raises(SystemExit):
-        bench.main(["--suite", "--suite-rows", "0,99"])
+        bench.main(["--suite", "--suite-rows", "0,99"])  # not names
     with pytest.raises(SystemExit):
-        bench.main(["--suite", "--suite-rows", "1",
+        bench.main(["--suite", "--suite-rows", "resnet50,nope"])
+    with pytest.raises(SystemExit):
+        bench.main(["--suite", "--suite-rows", "bert512",
                     "--suite-models", "resnet50"])
 
 
@@ -338,7 +344,8 @@ def test_suite_budget_zero_disables_gating(bench, monkeypatch, capsys):
         return 1.0
 
     monkeypatch.setattr(bench, "_child_measure", fake_measure)
-    monkeypatch.setattr(bench, "SUITE", (("resnet50", {}, 10_000),))
+    monkeypatch.setattr(bench, "SUITE",
+                        (("resnet50", "resnet50", {}, 10_000),))
     args = _args(bench, ["--suite", "--suite-budget", "0"])
     _run_child_with_fake_jax(bench, args)
     assert seen == [("resnet50", None)]
@@ -401,6 +408,53 @@ def test_unknown_model_omits_mfu_fields(bench, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "tflops_per_sec" not in rec and "mfu_pct" not in rec
     assert "fused_block" not in rec  # marker only when the flag is set
+
+
+def test_perleaf_allreduce_gets_its_own_metric_name(bench):
+    """The A/B's reference schedule (bucket_mb=0) must never evict the
+    fused row's last-good entry: metric-name separation + protocol
+    markers (docs/fused_allreduce.md A/B protocol)."""
+    fused = _args(bench, ["--model", "resnet50",
+                          "--allreduce-bucket-mb", "4"])
+    perleaf = _args(bench, ["--model", "resnet50",
+                            "--allreduce-bucket-mb", "0"])
+    default = _args(bench, ["--model", "resnet50"])
+    m_fused, _ = bench._metric_name_unit(fused)
+    m_perleaf, _ = bench._metric_name_unit(perleaf)
+    m_default, _ = bench._metric_name_unit(default)
+    assert m_fused == m_default  # fused IS the production metric
+    assert "_perleaf_ar" in m_perleaf and m_perleaf != m_fused
+    assert "ar4mb" in bench._protocol_suffix(fused)
+    assert "perleaf-ar" in bench._protocol_suffix(perleaf)
+    assert "ar" not in bench._protocol_suffix(default)
+    bf16 = _args(bench, ["--model", "resnet50", "--allreduce-dtype",
+                         "bfloat16"])
+    assert "ar-bf16" in bench._protocol_suffix(bf16)
+
+
+def test_allreduce_flag_validation_and_forwarding(bench):
+    with pytest.raises(SystemExit):
+        bench.main(["--allreduce-bucket-mb", "-1"])
+    # The parent must forward the protocol flags to the measuring child,
+    # or the A/B rows would silently measure the default schedule.
+    derived = {}
+
+    def fake_attempt(cmd, timeout, *, relay_errors, record_good=True,
+                     preflight=0):
+        derived["cmd"] = list(cmd)
+        return 1, "", 0
+
+    orig = bench._run_attempt
+    bench._run_attempt = fake_attempt
+    try:
+        bench.main(["--allreduce-bucket-mb", "0",
+                    "--allreduce-dtype", "bfloat16"])
+    finally:
+        bench._run_attempt = orig
+    cmd = derived["cmd"]
+    i = cmd.index("--allreduce-bucket-mb")
+    assert cmd[i + 1] == "0.0"
+    assert cmd[cmd.index("--allreduce-dtype") + 1] == "bfloat16"
 
 
 def test_last_good_cache_keyed_per_metric(bench, tmp_path):

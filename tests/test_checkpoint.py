@@ -40,6 +40,7 @@ def quiet():
     return MetricLogger(enabled=False)
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted(tmp_path, quiet):
     ckpt = str(tmp_path / "ckpt")
     # Uninterrupted 6-step run.
@@ -60,6 +61,7 @@ def test_resume_matches_uninterrupted(tmp_path, quiet):
     assert int(jax.device_get(part2["state"].step)) == 6
 
 
+@pytest.mark.slow
 def test_restore_is_noop_when_complete(tmp_path, quiet):
     cfg = tiny_cfg(checkpoint_dir=str(tmp_path / "ckpt"),
                    checkpoint_every_steps=100)
@@ -68,6 +70,7 @@ def test_restore_is_noop_when_complete(tmp_path, quiet):
     assert again["start_step"] == 2  # nothing re-trained
 
 
+@pytest.mark.slow
 def test_no_resume_flag(tmp_path, quiet):
     cfg = tiny_cfg(checkpoint_dir=str(tmp_path / "ckpt"))
     loop.run(cfg, total_steps=2, logger=quiet)
@@ -75,6 +78,7 @@ def test_no_resume_flag(tmp_path, quiet):
     assert fresh["start_step"] == 0
 
 
+@pytest.mark.slow
 def test_eval_top1_aggregates_across_shards(quiet):
     summary = loop.run(tiny_cfg(parallel=ParallelConfig(data=4)),
                        total_steps=2, logger=quiet, eval_batches=2)
@@ -96,6 +100,7 @@ def test_stream_meta_mismatch_fails_loudly(tmp_path):
         ckpt.close()
 
 
+@pytest.mark.slow
 def test_preemption_sigterm_saves_and_resumes(tmp_path):
     """SIGTERM mid-run (Cloud TPU preemption / launcher fail-whole grace
     window) triggers a synchronous save at the next step boundary and a
@@ -150,6 +155,7 @@ def test_preemption_sigterm_saves_and_resumes(tmp_path):
 
 
 @pytest.mark.core
+@pytest.mark.slow
 def test_preemption_resume_start_step(tmp_path, quiet):
     """In-process variant: a real SIGTERM delivered mid-run must trip the
     loop's preemption handler (SystemExit + synchronous save before any
@@ -193,6 +199,7 @@ def test_preemption_resume_start_step(tmp_path, quiet):
     assert resumed["final_step"] == saved + 1
 
 
+@pytest.mark.slow
 def test_eval_only_restores_and_scores(tmp_path, quiet):
     """--eval-only semantics: total_steps=0 + resume restores the newest
     checkpoint and jumps straight to final held-out eval, training nothing."""

@@ -1,0 +1,257 @@
+"""Bucketed (fused) gradient all-reduce — parallel/collectives.py.
+
+Two invariant families (docs/fused_allreduce.md):
+
+- The bucket planner is a pure function of (path, shape, dtype): stable
+  under container insertion-order churn, size-capped, and degenerate to
+  per-leaf at ``bucket_bytes<=0``.
+- Bucketing changes how many collectives launch, never which values are
+  summed: the fused reduce must match the per-leaf psum reference on the
+  8-fake-device harness — exactly at fp32 tolerance, and within the
+  documented tolerance for the bf16 payload policy.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearning_tpu import compat
+from distributeddeeplearning_tpu.config import AllReduceConfig, ParallelConfig
+from distributeddeeplearning_tpu.parallel import collectives
+from distributeddeeplearning_tpu.parallel.mesh import make_mesh
+
+AXES = ("data", "fsdp")
+
+
+def leaf_specs():
+    """A gradient-tree shape zoo: many small leaves plus one large one."""
+    return {
+        "conv1": {"kernel": (3, 3, 3, 8), "bias": (8,)},
+        "bn1": {"scale": (8,), "offset": (8,)},
+        "dense": {"kernel": (256, 128), "bias": (128,)},
+        "head": {"kernel": (128, 1000)},
+    }
+
+
+def struct_tree(dtype=jnp.float32):
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s, dtype), leaf_specs(),
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def value_tree(seed=0, dtype=jnp.float32):
+    """Per-shard values, leading dim 8 = one distinct slice per device."""
+    k = jax.random.key(seed)
+    out = {}
+    for mod, leaves in leaf_specs().items():
+        out[mod] = {}
+        for name, shape in leaves.items():
+            k, sub = jax.random.split(k)
+            out[mod][name] = jax.random.normal(sub, (8,) + shape, dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+
+def test_plan_is_stable_under_leaf_reordering():
+    """Same leaves, different dict insertion order => identical assignment
+    (keyed by sorted path, the determinism contract chip runs rely on)."""
+    tree = struct_tree()
+    reordered = {mod: dict(reversed(list(leaves.items())))
+                 for mod, leaves in reversed(list(tree.items()))}
+    cap = 64 * 1024  # small enough to force several buckets
+    a = collectives.plan_buckets(tree, cap)
+    b = collectives.plan_buckets(reordered, cap)
+    assert len(a.buckets) == len(b.buckets) > 1
+    for path in a.paths:
+        assert a.bucket_of(path) == b.bucket_of(path), path
+    # And the payload order within buckets is identical too.
+    assert tuple(tuple(a.paths[i] for i in m) for m in a.buckets) == \
+        tuple(tuple(b.paths[i] for i in m) for m in b.buckets)
+
+
+def test_plan_respects_size_cap_and_isolates_oversized_leaves():
+    cap = 64 * 1024
+    plan = collectives.plan_buckets(struct_tree(), cap)
+    for members in plan.buckets:
+        nbytes = sum(
+            collectives._numel(plan.shapes[i]) * plan.dtypes[i].itemsize
+            for i in members)
+        # A bucket may exceed the cap only when a single leaf alone does.
+        assert nbytes <= cap or len(members) == 1
+    # The 128x1000 fp32 head (500 KB > 64 KB) must sit alone.
+    head = plan.bucket_of("['head']['kernel']")
+    assert len(plan.buckets[head]) == 1
+
+
+def test_plan_zero_bytes_degenerates_to_per_leaf():
+    plan = collectives.plan_buckets(struct_tree(), 0)
+    assert len(plan.buckets) == plan.num_leaves
+    assert all(len(m) == 1 for m in plan.buckets)
+
+
+def test_plan_covers_every_leaf_exactly_once():
+    plan = collectives.plan_buckets(struct_tree(), 32 * 1024)
+    seen = sorted(i for m in plan.buckets for i in m)
+    assert seen == list(range(plan.num_leaves))
+
+
+# ---------------------------------------------------------------------------
+# Numeric parity on 8 fake devices
+# ---------------------------------------------------------------------------
+
+
+def reduce_on_mesh(tree, devices8, **kw):
+    """Run all_reduce under shard_map: each device holds slice [d] of every
+    leaf; the reduce must return the cross-device sum, replicated."""
+    mesh = make_mesh(ParallelConfig(data=8))
+
+    def f(local):
+        local = jax.tree_util.tree_map(lambda x: x[0], local)
+        return collectives.all_reduce(local, AXES, axis_size=8, **kw)
+
+    fn = compat.shard_map(f, mesh=mesh, in_specs=P(AXES), out_specs=P())
+    return jax.device_get(jax.jit(fn)(tree))
+
+
+def reference_sum(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float64).sum(axis=0), tree)
+
+
+@pytest.mark.core
+def test_fused_matches_perleaf_fp32(devices8):
+    """Bucketed fp32 reduce == per-leaf reduce == direct sum, at fp32
+    tolerance (the acceptance criterion for the tensor-fusion change)."""
+    tree = value_tree()
+    ref = reference_sum(tree)
+    fused = reduce_on_mesh(tree, devices8, bucket_bytes=64 * 1024)
+    perleaf = reduce_on_mesh(tree, devices8, bucket_bytes=0)
+    for f, p, r in zip(jax.tree_util.tree_leaves(fused),
+                       jax.tree_util.tree_leaves(perleaf),
+                       jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(f, r, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(p, r, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(f, p, rtol=1e-6, atol=0)
+
+
+def test_single_default_bucket_matches(devices8):
+    """The whole tree fits one 4 MB default bucket — the common CNN case."""
+    tree = value_tree(seed=1)
+    ref = reference_sum(tree)
+    out = reduce_on_mesh(tree, devices8)  # default bucket_bytes
+    for o, r in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(o, r, rtol=1e-6, atol=1e-6)
+
+
+def test_bf16_payload_within_documented_tolerance(devices8):
+    """bf16 wire compression: result restored to fp32, within the 8-bit-
+    mantissa tolerance documented in docs/fused_allreduce.md."""
+    tree = value_tree(seed=2)
+    ref = reference_sum(tree)
+    out = reduce_on_mesh(tree, devices8, bucket_bytes=64 * 1024,
+                         payload_dtype=jnp.bfloat16)
+    for o, r in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        assert o.dtype == np.float32  # fp32 master restored
+        # rtol covers the 8-bit-mantissa rounding of each payload; atol
+        # covers cancellation — a near-zero SUM of eight O(1) bf16 terms
+        # keeps the absolute error of its largest term.
+        np.testing.assert_allclose(o, r, rtol=2e-2, atol=5e-2)
+
+
+def test_ring_algorithm_matches_psum(devices8):
+    """psum_scatter+all_gather (with odd-size padding) == plain psum."""
+    tree = value_tree(seed=3)
+    ref = reference_sum(tree)
+    # 64 KB buckets make several payloads whose element counts are not
+    # multiples of 8, exercising the pad/strip path.
+    out = reduce_on_mesh(tree, devices8, bucket_bytes=64 * 1024,
+                         algorithm="ring")
+    for o, r in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(o, r, rtol=1e-6, atol=1e-6)
+
+
+def test_all_reduce_gradients_reads_options(devices8):
+    """The train-step entry point honors AllReduceConfig and rejects
+    unsupported payload dtypes at trace time."""
+    tree = value_tree(seed=4)
+    ref = reference_sum(tree)
+    mesh = make_mesh(ParallelConfig(data=8))
+    opts = AllReduceConfig(bucket_mb=0.0625, dtype="float32",
+                           algorithm="psum")
+
+    def f(local):
+        local = jax.tree_util.tree_map(lambda x: x[0], local)
+        return collectives.all_reduce_gradients(local, AXES, axis_size=8,
+                                                options=opts)
+
+    fn = jax.jit(compat.shard_map(f, mesh=mesh, in_specs=P(AXES),
+                                  out_specs=P()))
+    out = jax.device_get(fn(tree))
+    for o, r in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(o, r, rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(ValueError, match="not supported"):
+        collectives.all_reduce_gradients(
+            jax.tree_util.tree_map(lambda x: x[0], tree), AXES, axis_size=8,
+            options=AllReduceConfig(dtype="float16"))
+
+
+def test_plan_mismatch_raises():
+    tree = struct_tree()
+    plan = collectives.plan_buckets(tree, 0)
+    smaller = {"conv1": tree["conv1"]}
+    with pytest.raises(ValueError, match="leaves"):
+        collectives.all_reduce(
+            jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                   smaller),
+            AXES, axis_size=1, plan=plan)
+
+
+# ---------------------------------------------------------------------------
+# CLI round-trip (train.py)
+# ---------------------------------------------------------------------------
+
+
+def test_train_cli_roundtrip_allreduce_flags():
+    import train
+
+    cfg = train.build_config(train.parse_args(
+        ["--allreduce-bucket-mb", "8", "--allreduce-dtype", "bfloat16",
+         "--allreduce-algo", "ring"]))
+    assert cfg.allreduce.bucket_mb == 8.0
+    assert cfg.allreduce.dtype == "bfloat16"
+    assert cfg.allreduce.algorithm == "ring"
+    assert "fused" in cfg.allreduce.describe()
+
+    # Defaults untouched when no flag is passed.
+    base = train.build_config(train.parse_args([]))
+    assert base.allreduce == AllReduceConfig()
+    assert base.allreduce.bucket_mb == collectives.DEFAULT_BUCKET_MB
+
+    # 0 selects the per-leaf reference path; negatives are rejected.
+    perleaf = train.build_config(train.parse_args(
+        ["--allreduce-bucket-mb", "0"]))
+    assert perleaf.allreduce.bucket_mb == 0.0
+    assert "per-leaf" in perleaf.allreduce.describe()
+    with pytest.raises(SystemExit):
+        train.build_config(train.parse_args(["--allreduce-bucket-mb", "-1"]))
+
+
+def test_allreduce_config_is_replace_safe():
+    """bench.py builds AllReduceConfig via dataclasses.replace — keep it a
+    plain frozen-compatible dataclass."""
+    cfg = AllReduceConfig()
+    new = dataclasses.replace(cfg, bucket_mb=0.0)
+    assert new.bucket_mb == 0.0 and cfg.bucket_mb == collectives.DEFAULT_BUCKET_MB

@@ -29,6 +29,7 @@ def _cfg(model="resnet18_thin", ema=0.5, **kw):
 
 @pytest.mark.core
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_ema_matches_manual_recursion():
     cfg = _cfg()
     mesh, model, shd, state, step, _, rng = loop.build(cfg, 3)
@@ -46,6 +47,7 @@ def test_ema_matches_manual_recursion():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_ema_gspmd_path_and_off_by_default():
     cfg = _cfg(model="bert_tiny", ema=0.9)
     mesh, model, shd, state, step, _, rng = loop.build(cfg, 2)
@@ -62,6 +64,7 @@ def test_ema_gspmd_path_and_off_by_default():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_eval_scores_ema_weights(tmp_path):
     """decay=0.999 over 20 steps keeps the EMA ~98% at init: trained
     params improve but the eval (which must score the EMA) stays near
@@ -79,6 +82,7 @@ def test_eval_scores_ema_weights(tmp_path):
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_ema_checkpoint_roundtrip(tmp_path):
     ck = str(tmp_path / "ck")
     loop.run(_cfg(checkpoint_dir=ck, checkpoint_every_steps=2),
@@ -96,6 +100,7 @@ def test_ema_decay_one_rejected():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_eval_only_restores_checkpointed_ema(tmp_path):
     """The reviewer scenario: restore_latest_for_eval must surface the
     CHECKPOINT's EMA (trained shadow weights), never a fresh-init EMA from
@@ -139,6 +144,7 @@ def test_eval_only_restores_checkpointed_ema(tmp_path):
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_training_resume_across_ema_flag_change(tmp_path):
     """restore_latest (the TRAINING resume path) across an --ema-decay
     flip, which previously died in an opaque orbax structure-mismatch

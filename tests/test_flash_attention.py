@@ -112,6 +112,7 @@ def test_bfloat16_forward():
                                rtol=5e-2, atol=5e-2)
 
 
+@pytest.mark.slow
 def test_bert_flash_end_to_end_sharded():
     """Tiny BERT trains with flash attention on a dp x tp mesh through the
     GSPMD path — the kernel runs per-shard under shard_map."""

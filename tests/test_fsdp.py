@@ -59,6 +59,7 @@ def test_fsdp_params_actually_shard(devices8):
 
 
 @pytest.mark.core
+@pytest.mark.slow
 def test_fsdp_matches_dp_numerics(devices8):
     """3 training steps under fsdp=2 == pure dp=8, same seed/batches."""
     losses = {}

@@ -13,6 +13,11 @@ import pytest
 
 from distributeddeeplearning_tpu.ops import fused_batchnorm as fbn
 
+# Every test here compiles multi-device programs — minutes on
+# the 1-vCPU CPU harness, so the whole file runs in the slow
+# tier (tier-1 keeps its sub-15-min budget).
+pytestmark = pytest.mark.slow
+
 EPS = 1e-5
 
 

@@ -59,6 +59,7 @@ def test_forward_and_stats_match(shared):
                                    err_msg=jax.tree_util.keystr(pa))
 
 
+@pytest.mark.slow
 def test_gradients_match(shared):
     x, variables = shared
     labels = jnp.arange(4) % 10
@@ -89,6 +90,7 @@ def test_eval_path_matches(shared):
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_fused_block_dp_step_matches_unfused():
     """Two DP train steps over the 8-device mesh: fused_block on/off give
     the same loss trajectory (the shard_map/check_vma jnp-twin path)."""

@@ -106,6 +106,7 @@ def _tiny(fused_block, fused_conv3, dtype=jnp.float32):
                   fused_conv3=fused_conv3)
 
 
+@pytest.mark.slow
 def test_model_forward_and_grads_match_unfused():
     """ResNet(fused_conv3) vs the classic path, shared weights: forward,
     batch-stats updates, and parameter gradients. The [1,1] net has a
@@ -142,6 +143,7 @@ def test_model_forward_and_grads_match_unfused():
 
 @pytest.mark.usefixtures("devices8")
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.slow
 def test_fused_conv3_dp_step_matches_unfused(dtype):
     """Two DP train steps over the 8-device mesh: fused_conv3 on/off give
     the same loss trajectory. This is the shard_map/check_vma jnp-twin

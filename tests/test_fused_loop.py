@@ -25,6 +25,11 @@ from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.train import loop, optim, steps
 from distributeddeeplearning_tpu.train.state import TrainState
 
+# Every test here compiles multi-device programs — minutes on
+# the 1-vCPU CPU harness, so the whole file runs in the slow
+# tier (tier-1 keeps its sub-15-min budget).
+pytestmark = pytest.mark.slow
+
 
 class _TinyNet(nn.Module):
     """BN-free classifier: no cross-example normalization, so the only

@@ -50,6 +50,7 @@ def test_autoregressive_property():
     assert np.abs(np.asarray(base[0, 10:]) - np.asarray(out[0, 10:])).max() > 0
 
 
+@pytest.mark.slow
 def test_causal_flash_matches_dense():
     """Same params, flash vs dense attention impl: same logits and grads."""
     ids = jax.random.randint(jax.random.key(0), (2, 32), 1, 128)
@@ -74,6 +75,7 @@ def test_causal_flash_matches_dense():
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4), g_d, g_f)
 
 
+@pytest.mark.slow
 def test_causal_step_trains_dp_tp(devices8):
     from distributeddeeplearning_tpu.data.synthetic import (
         SyntheticCausalTokens)
@@ -108,6 +110,7 @@ def test_causal_step_trains_dp_tp(devices8):
     assert last < first, (first, last)
 
 
+@pytest.mark.slow
 def test_gpt_runs_via_loop(devices8):
     """The CLI path: loop.run on gpt_tiny with synthetic causal data."""
     from distributeddeeplearning_tpu.train import loop
@@ -123,6 +126,7 @@ def test_gpt_runs_via_loop(devices8):
     assert np.isfinite(summary["final_metrics"]["loss"])
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_trains(devices8):
     """GPT over pp x dp x tp: the GPipe schedule serves decoder blocks too."""
     from distributeddeeplearning_tpu.train import loop

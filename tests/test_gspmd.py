@@ -53,6 +53,7 @@ def test_tp_kernel_sharding(devices8):
     assert emb.sharding.spec == P("model", None)  # vocab-parallel
 
 
+@pytest.mark.slow
 def test_tp_sp_step_trains(devices8):
     _, _, _, src, state, step, rng = build_sharded(
         ParallelConfig(data=2, seq=2, model=2), devices8)
@@ -118,6 +119,7 @@ def test_emulated_hybrid_mesh_layout(devices8):
     assert (slice_of[:, 0] == slice_of[:, 1]).all()
 
 
+@pytest.mark.slow
 def test_emulated_hybrid_mesh_trains(devices8):
     # A dp x tp step over the emulated 2-slice mesh compiles and runs.
     cfg = bert_cfg(ParallelConfig(data=4, model=2, emulate_slices=2))

@@ -141,6 +141,7 @@ def test_stream_source_enforces_order(tfrecord_dir):
         src.batch(5)
 
 
+@pytest.mark.slow
 def test_train_end_to_end_real_data(tfrecord_dir):
     """Integration: loss decreases training on the (trivially separable)
     class-colored dataset through the full loop + real pipeline."""
@@ -154,6 +155,7 @@ def test_train_end_to_end_real_data(tfrecord_dir):
     assert 0.0 <= summary["eval_top1"] <= 1.0
 
 
+@pytest.mark.slow
 def test_eval_survives_short_validation_split(tfrecord_dir):
     """A val split smaller than eval_batches x batch must score the
     batches that exist (with a warning), not crash mid-training with a

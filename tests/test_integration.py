@@ -22,6 +22,7 @@ def test_presets_construct():
         assert cfg.parallel.num_devices >= 1
 
 
+@pytest.mark.slow
 def test_loop_smoke_resnet():
     cfg = TrainConfig(model="resnet18", global_batch_size=16, dtype="float32",
                       log_every=10**9, parallel=ParallelConfig(data=8),
@@ -40,6 +41,7 @@ def test_graft_entry_forward():
     assert out.shape == (8, 1000)
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
@@ -69,6 +71,7 @@ def test_tensorboard_metric_mirror(tmp_path):
     assert events and events[0].stat().st_size > 0
 
 
+@pytest.mark.slow
 def test_profiler_trace_capture(tmp_path):
     """profile_steps=(1,2) writes a jax.profiler trace dir (SURVEY.md §5.1)."""
     cfg = TrainConfig(model="resnet18", global_batch_size=8, dtype="float32",

@@ -69,6 +69,7 @@ def test_forward_shape_gqa_and_causality():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_llama_trains_gspmd_tp():
     from distributeddeeplearning_tpu.config import (
         DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)

@@ -78,6 +78,7 @@ def test_tokens_gather_mask_batch():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_gather_mlm_trains_and_evals_gspmd():
     from distributeddeeplearning_tpu.train import loop
 
@@ -97,6 +98,7 @@ def test_gather_mlm_trains_and_evals_gspmd():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_gather_loss_tracks_dense_loss():
     """Same model/params: the gathered loss at step 0 must be ~ln(vocab),
     like the dense loss — a smoke check that labels/positions pair up."""

@@ -18,6 +18,7 @@ from distributeddeeplearning_tpu.models import bert
 from distributeddeeplearning_tpu.models.moe import MoeMlp
 from distributeddeeplearning_tpu.parallel.mesh import make_mesh
 from distributeddeeplearning_tpu.train import optim, steps
+import pytest
 
 
 def test_top1_routing_matches_dense_reference():
@@ -105,6 +106,7 @@ def test_expert_kernels_shard(devices8):
     assert "moe_mlp" not in state.params["layer0"]
 
 
+@pytest.mark.slow
 def test_moe_step_trains_ep(devices8):
     src, state, step = _build(ParallelConfig(data=2, expert=2, model=2))
     rng = jax.random.key(42)
@@ -221,6 +223,7 @@ def test_top2_capacity_priority():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_top2_trains_via_loop(devices8):
     """bert_tiny with top-2 MoE trains one step under dp x ep."""
     from distributeddeeplearning_tpu.train import loop

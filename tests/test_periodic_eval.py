@@ -12,6 +12,11 @@ from distributeddeeplearning_tpu.config import (
 from distributeddeeplearning_tpu.train import loop
 from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
+# Every test here compiles multi-device programs — minutes on
+# the 1-vCPU CPU harness, so the whole file runs in the slow
+# tier (tier-1 keeps its sub-15-min budget).
+pytestmark = pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(

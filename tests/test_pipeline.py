@@ -20,6 +20,7 @@ from distributeddeeplearning_tpu.models import bert, model_spec
 from distributeddeeplearning_tpu.models.pipeline import PipelinedEncoder
 from distributeddeeplearning_tpu.parallel.mesh import make_mesh
 from distributeddeeplearning_tpu.train import optim, steps
+import pytest
 
 
 def test_pipeline_matches_sequential():
@@ -84,6 +85,7 @@ def test_pp_params_shard(devices8):
     assert qk.sharding.spec == P("pipeline", None, None, "model"), qk.sharding
 
 
+@pytest.mark.slow
 def test_pp_step_trains(devices8):
     src, state, step = _build()
     rng = jax.random.key(42)

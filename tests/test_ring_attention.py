@@ -85,6 +85,7 @@ def test_ring_grads_match_dense():
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_ring_end_to_end():
     """Tiny BERT trains one step with ring attention on a dp x sp x tp mesh
     through the real GSPMD train path (the longctx preset's shape)."""
@@ -161,6 +162,7 @@ def test_causal_ring_matches_causal_dense(seq_shards):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_ring_runs_via_loop(devices8):
     """Long-context causal config: GPT over dp x sp via the standard loop."""
     from distributeddeeplearning_tpu.train import loop
@@ -245,6 +247,7 @@ def test_zigzag_indices_roundtrip():
     np.testing.assert_array_equal(perm[:8], list(range(0, 4)) + list(range(28, 32)))
 
 
+@pytest.mark.slow
 def test_gpt_zigzag_runs_via_loop(devices8):
     """--attn zigzag end-to-end: GPT over dp x sp via the standard loop,
     whole transformer in zigzag layout (models/gpt.py permutes in/out)."""
@@ -262,6 +265,7 @@ def test_gpt_zigzag_runs_via_loop(devices8):
 
 
 @pytest.mark.core
+@pytest.mark.slow
 def test_gpt_zigzag_logits_match_dense(devices8):
     """The zigzag GPT forward equals the dense-attention forward in natural
     order — the permute/position/unpermute plumbing is numerics-exact."""
@@ -285,6 +289,7 @@ def test_gpt_zigzag_logits_match_dense(devices8):
 
 
 @pytest.mark.core
+@pytest.mark.slow
 def test_llama_zigzag_logits_match_dense(devices8):
     """Llama's zigzag forward equals its dense forward in natural order —
     specifically pinning RoPE: in permuted layout the rotation must follow
@@ -307,6 +312,7 @@ def test_llama_zigzag_logits_match_dense(devices8):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_zigzag_runs_via_loop(devices8):
     """--model llama --attn zigzag end-to-end over dp x sp, including the
     remat path threading positions through nn.remat."""

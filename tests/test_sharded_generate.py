@@ -24,6 +24,11 @@ from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel.mesh import use_mesh
 from distributeddeeplearning_tpu.train import loop
 
+# Every test here compiles multi-device programs — minutes on
+# the 1-vCPU CPU harness, so the whole file runs in the slow
+# tier (tier-1 keeps its sub-15-min budget).
+pytestmark = pytest.mark.slow
+
 
 def _build(tp: int):
     cfg = TrainConfig(

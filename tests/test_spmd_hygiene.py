@@ -65,6 +65,7 @@ print(json.dumps(loop.run(cfg, total_steps=1)))
 """
 
 
+@pytest.mark.slow
 def test_fsdp_compile_has_no_involuntary_rematerialization():
     """Compile+run the exact dp x fsdp config that used to warn, in a
     subprocess (XLA warnings go to the process stderr, not Python's), and

@@ -42,6 +42,7 @@ def _run_steps(cfg, n=3):
 
 @pytest.mark.core
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_sync_bn_dp8_matches_dp1_exactly():
     """The defining invariant: global statistics make the whole training
     trajectory mesh-independent — exact to float32 tolerance."""
@@ -56,6 +57,7 @@ def test_sync_bn_dp8_matches_dp1_exactly():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_per_shard_bn_differs_from_dp1():
     """Control: WITHOUT sync_bn the same setup diverges (per-shard
     statistics see batch 2, dp1 sees batch 16) — proving the invariant
@@ -69,6 +71,7 @@ def test_per_shard_bn_differs_from_dp1():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_sync_bn_rescues_batch1_per_shard():
     """8 shards x 1 image: per-shard BN degenerates (loss pins at ln(10),
     see train/loop.py's warning); sync_bn pools statistics across the
@@ -83,6 +86,7 @@ def test_sync_bn_rescues_batch1_per_shard():
 
 
 @pytest.mark.usefixtures("devices8")
+@pytest.mark.slow
 def test_sync_bn_fused_block_matches_unfused():
     """fused_block's epilogue-sum statistics pmean identically to the
     unfused path's: same trajectory with both flags on."""

@@ -81,12 +81,14 @@ timeout 480 python tools/ab_fused_block.py --batches 512 \
 note fused_block
 
 check_stop suite_top
-# 3. Highest-value suite rows under an explicit row budget: SUITE rows
-# 0-3 = resnet50 (acceptance row, cache hot from step 1), BERT-512 flash,
-# gpt2, BERT-512 dense (gather-head protocol, never measured on chip).
+# 3. Highest-value suite rows under an explicit row budget, selected BY
+# NAME (index selection broke silently whenever SUITE gained a row):
+# resnet50 (acceptance row, cache hot from step 1), BERT-512 flash, gpt2,
+# BERT-512 dense (gather-head protocol, never measured on chip).
 # bench.py admits rows against the budget and cuts overruns, so this step
 # degrades to the best prefix rather than overshooting. P50 ~7 min.
-timeout 540 python bench.py --suite --budget 520 --suite-rows 0,1,2,3 \
+timeout 540 python bench.py --suite --budget 520 \
+  --suite-rows resnet50,bert512_flash,gpt2_1024,bert512 \
   > "$RES/bench_suite_top.json" 2>> "$RES/log.txt"
 note suite_top
 
@@ -117,17 +119,32 @@ check_stop fused_conv3
 timeout 420 python tools/validate_fused_conv_tpu.py --quick \
   > "$RES/fused_conv3_validate.json" 2>> "$RES/log.txt"
 note fused_conv3_validate
+check_stop fused_conv3_ab
+# The 700s three-way A/B is the most expensive single step in the window;
+# a hard stop landing between validate and A/B must skip it rather than
+# start a run the driver's own bench would then contend with.
 timeout 700 python tools/ab_fused_block.py --batches 512 --conv3 \
   > "$RES/fused_conv3_ab.json" 2>> "$RES/log.txt"
 note fused_conv3_ab
 
 check_stop suite_rest
-# 6. Remaining suite rows: SUITE rows 4-7 = resnet152, densenet121,
-# vit_b16, bert-2048 flash+remat (exact-row selection — a model-name
-# filter would re-admit the bert rows step 3 already measured).
-timeout 900 python bench.py --suite --budget 860 --suite-rows 4,5,6,7 \
+# 6. Remaining suite rows: resnet152, densenet121, vit_b16, bert-2048
+# flash+remat (exact-row selection by name — a model-name filter would
+# re-admit the bert rows step 3 already measured).
+timeout 900 python bench.py --suite --budget 860 \
+  --suite-rows resnet152,densenet121,vit_b16,bert2048_flash \
   > "$RES/bench_suite_rest.json" 2>> "$RES/log.txt"
 note suite_rest
+
+check_stop allreduce_ab
+# 6b. Fused vs per-leaf gradient all-reduce A/B (the bucketed-collective
+# verdict): same model/batch as the acceptance row, only the reduction
+# protocol differs. Per-leaf writes its own metric name (_perleaf_ar), so
+# the fused row's last-good cache is never polluted. ~2 x 90 s + compile.
+timeout 480 python bench.py --suite --budget 440 \
+  --suite-rows ar_fused,ar_perleaf \
+  > "$RES/bench_allreduce_ab.json" 2>> "$RES/log.txt"
+note allreduce_ab
 
 check_stop real_data
 # 7. Remaining real-data legs: native C++ loader + grain only (tf was

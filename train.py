@@ -80,6 +80,23 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ema-decay", type=float, default=None,
                    help="exponential-moving-average of params (e.g. "
                         "0.9999); evals score the EMA weights")
+    p.add_argument("--allreduce-bucket-mb", type=float, default=None,
+                   help="gradient tensor-fusion bucket size in MB for the "
+                        "explicit-DP path (parallel/collectives.py); one "
+                        "collective per bucket instead of per parameter "
+                        "leaf. 0 = per-leaf reduction (the unfused A/B "
+                        "baseline); default 4")
+    p.add_argument("--allreduce-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="gradient all-reduce payload dtype: bfloat16 halves "
+                        "the wire bytes and restores fp32 masters after the "
+                        "reduce (documented tolerance, docs/"
+                        "fused_allreduce.md)")
+    p.add_argument("--allreduce-algo", default=None,
+                   choices=["psum", "ring"],
+                   help="per-bucket collective: one psum, or the "
+                        "bandwidth-optimal psum_scatter+all_gather ring "
+                        "form")
     p.add_argument("--sync-bn", action="store_true", default=None,
                    help="cross-replica BatchNorm statistics (psum over the "
                         "data axis, torch SyncBatchNorm semantics; pure-DP "
@@ -221,6 +238,20 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(fused_conv3=True)
     if args.sync_bn:
         cfg = cfg.replace(sync_bn=True)
+    ar_updates = {}
+    if args.allreduce_bucket_mb is not None:
+        if args.allreduce_bucket_mb < 0:
+            raise SystemExit(f"--allreduce-bucket-mb must be >= 0 "
+                             f"(got {args.allreduce_bucket_mb}); 0 selects "
+                             f"per-leaf reduction")
+        ar_updates["bucket_mb"] = args.allreduce_bucket_mb
+    if args.allreduce_dtype:
+        ar_updates["dtype"] = args.allreduce_dtype
+    if args.allreduce_algo:
+        ar_updates["algorithm"] = args.allreduce_algo
+    if ar_updates:
+        cfg = cfg.replace(
+            allreduce=dataclasses.replace(cfg.allreduce, **ar_updates))
     if args.ema_decay is not None:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, ema_decay=args.ema_decay))

@@ -85,6 +85,14 @@ SUITE = (
     # exists to quantify.
     ("ar_fused", "resnet50", {"allreduce_bucket_mb": 4.0}, 90),
     ("ar_perleaf", "resnet50", {"allreduce_bucket_mb": 0.0}, 90),
+    # ZeRO-1 optimizer sharding vs the fused all-reduce it replaces
+    # (parallel/zero.py): reduce-scatter + shard-local update + param
+    # all-gather, same wire volume, opt state 1/N per chip. Paired with
+    # ar_fused (same model/batch/bucket) so the throughput delta isolates
+    # the schedule change; the memory win shows in the per-device
+    # opt_state bytes every record now carries. Never measured on chip.
+    ("zero1", "resnet50", {"allreduce_bucket_mb": 4.0,
+                           "optimizer_sharding": "zero1"}, 90),
     # Never measured on chip under the gather-head protocol (r2 protocol
     # change) — the two highest-value unknown rows.
     ("bert512_flash", "bert_base", {"batch_size": 32, "seq_len": 512,
@@ -133,6 +141,11 @@ def _metric_name_unit(args) -> tuple[str, str]:
     # last-good entry under the same key.
     perleaf = ("_perleaf_ar"
                if getattr(args, "allreduce_bucket_mb", None) == 0 else "")
+    # ZeRO-1 rows likewise get their own metric name: the sharded-optimizer
+    # schedule is a different measurement protocol and its number must not
+    # evict the replicated headline's last-good entry.
+    if getattr(args, "optimizer_sharding", None) == "zero1":
+        perleaf += "_zero1"
     if objective:
         gather = f"_g{mp}" if mp > 0 else ""
         return (f"{args.model}{perleaf}_{objective}_s{args.seq_len}{gather}"
@@ -164,6 +177,8 @@ def _protocol_suffix(args) -> str:
         parts.append("perleaf-ar" if ar_mb == 0 else f"ar{ar_mb:g}mb")
     if getattr(args, "allreduce_dtype", None) == "bfloat16":
         parts.append("ar-bf16")
+    if getattr(args, "optimizer_sharding", None) == "zero1":
+        parts.append("zero1")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -198,7 +213,8 @@ def _mfu_fields(args, value: float) -> dict:
         return {}
 
 
-def _emit_metric(args, value: float, protocol: str) -> None:
+def _emit_metric(args, value: float, protocol: str,
+                 extra: dict | None = None) -> None:
     metric, unit = _metric_name_unit(args)
     # The 1450 img/s denominator is specifically the V100 ResNet50 AMP
     # figure — comparing any other model against it would be meaningless,
@@ -220,6 +236,8 @@ def _emit_metric(args, value: float, protocol: str) -> None:
         rec["fused_block"] = True
     if getattr(args, "fused_conv3", False):
         rec["fused_conv3"] = True
+    if extra:
+        rec.update(extra)
     print(json.dumps(rec), flush=True)
 
 
@@ -281,7 +299,9 @@ def _child_measure(args, emit_quick: bool = True,
         fused_conv3=getattr(args, "fused_conv3", False),
         parallel=ParallelConfig(data=n_dev),
         data=data,
-        allreduce=AllReduceConfig(**ar_kw))
+        allreduce=AllReduceConfig(**ar_kw),
+        optimizer_sharding=(getattr(args, "optimizer_sharding", None)
+                            or "none"))
 
     quick_w = (args.warmup_steps if args.warmup_steps is not None
                else args.quick_warmup)
@@ -305,6 +325,18 @@ def _child_measure(args, emit_quick: bool = True,
     jax.device_get(metrics)
     _note(f"compile+warmup({quick_w}) done in "
           f"{time.perf_counter() - t_compile:.1f}s; quick window starts")
+    # Per-device memory annotation for every metric line this row emits:
+    # peak HBM where the allocator reports it, plus params/opt-state
+    # resident bytes (shard-aware) — the numbers the ZeRO-1 A/B compares.
+    mem = {}
+    try:
+        stats = loop._device_memory_stats(state)
+        for key in ("peak_bytes_in_use", "bytes_in_use",
+                    "params_bytes_per_device", "opt_state_bytes_per_device"):
+            if key in stats:
+                mem[key] = int(stats[key])
+    except Exception:
+        pass  # annotation only — never costs a measurement
     def timed_window(n_steps: int):
         """Dispatch up to n_steps; returns (steps_done, elapsed).
 
@@ -340,7 +372,7 @@ def _child_measure(args, emit_quick: bool = True,
     if emit_quick and q_done:
         _emit_metric(args, q_rate,
                      protocol=f"quick w{quick_w}+{q_done} "
-                              f"b{args.batch_size}{mark}")
+                              f"b{args.batch_size}{mark}", extra=mem)
     # Full-protocol window: everything so far (quick_w + quick_n >= the
     # classic 10) counts as warmup; time a fresh window of args.steps.
     if deadline is None or time.monotonic() < deadline:
@@ -353,7 +385,8 @@ def _child_measure(args, emit_quick: bool = True,
         if emit_final:
             _emit_metric(args, rate,
                          protocol=f"w{quick_w + q_done}+{done} "
-                                  f"b{args.batch_size}{mark}{cut}")
+                                  f"b{args.batch_size}{mark}{cut}",
+                         extra=mem)
         return rate
     if q_done:
         # Deadline landed inside the quick window: the quick measurement
@@ -361,7 +394,8 @@ def _child_measure(args, emit_quick: bool = True,
         if emit_final:
             _emit_metric(args, q_rate,
                          protocol=f"quick w{quick_w}+{q_done} "
-                                  f"b{args.batch_size}{mark} cut")
+                                  f"b{args.batch_size}{mark} cut",
+                         extra=mem)
         return q_rate
     raise TimeoutError(
         f"row deadline passed before any timed step (warmup {quick_w})")
@@ -478,6 +512,7 @@ def _child(args) -> int:
         row.attention_impl, row.remat, row.fused_bn = None, False, False
         row.fused_block = row.fused_conv3 = False
         row.allreduce_bucket_mb = row.allreduce_dtype = None
+        row.optimizer_sharding = None
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -688,6 +723,12 @@ def main(argv=None) -> int:
                    choices=[None, "float32", "bfloat16"],
                    help="gradient all-reduce payload dtype (bfloat16 = "
                         "compressed wire payload, fp32 restored after)")
+    p.add_argument("--optimizer-sharding", default=None,
+                   choices=[None, "none", "zero1"],
+                   help="ZeRO-1 optimizer-state sharding (parallel/zero.py): "
+                        "reduce-scatter grads, update 1/N of the params per "
+                        "chip, all-gather; emitted under its own _zero1 "
+                        "metric name; unset = replicated optimizer")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -812,6 +853,8 @@ def main(argv=None) -> int:
         child_cmd += ["--allreduce-bucket-mb", str(args.allreduce_bucket_mb)]
     if args.allreduce_dtype:
         child_cmd += ["--allreduce-dtype", args.allreduce_dtype]
+    if args.optimizer_sharding:
+        child_cmd += ["--optimizer-sharding", args.optimizer_sharding]
     if args.suite:
         child_cmd += ["--suite"]
         if args.suite_models:

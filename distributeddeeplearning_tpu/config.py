@@ -171,6 +171,14 @@ class TrainConfig:
                                   # requires fused_block
     sync_bn: bool = False         # cross-replica BN statistics (psum over
                                   # the data axis; torch SyncBatchNorm)
+    optimizer_sharding: str = "none"  # none | zero1 (explicit-DP path only):
+                                  # ZeRO-1 — reduce-scatter grads, update
+                                  # each shard's 1/N param chunk against
+                                  # permanently sharded optimizer state,
+                                  # all-gather updated params. Same comm
+                                  # volume as the ring all-reduce, optimizer
+                                  # HBM / update FLOPs divided by the DP
+                                  # degree (parallel/zero.py)
     # GPipe microbatch count for *_pp models (None = model default). The
     # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
     # under ~20% (tools/bench_parallel_overhead.py measures this).

@@ -37,6 +37,17 @@ from distributeddeeplearning_tpu.data.imagenet import (
     CROP_PADDING, MEAN_RGB, STDDEV_RGB, StreamSource, _per_process_batch,
     folder_index)
 
+# grain dispatches two-arg random_map(record, rng) ONLY to isinstance
+# subclasses of its RandomMapTransform protocol — a plain callable gets the
+# one-arg map() call and the per-record RNG never arrives. Import guarded:
+# the transform stays directly usable (PIL decode paths, tests) on hosts
+# without grain installed.
+try:
+    from grain.python import RandomMapTransform as _RandomMapBase
+except Exception:  # pragma: no cover - grain is an optional dependency
+    class _RandomMapBase:
+        pass
+
 
 class ImageFolderSource:
     """grain RandomAccessDataSource over an indexed image-folder split."""
@@ -74,9 +85,10 @@ def _random_crop_box(rng: np.random.Generator, width: int, height: int,
 
 
 @dataclasses.dataclass
-class DecodeAndAugment:
+class DecodeAndAugment(_RandomMapBase):
     """Per-record decode + augment, run under grain's per-record RNG
-    (grain.python.RandomMapTransform protocol via __call__(record, rng)).
+    (a grain.python.RandomMapTransform; ``__call__`` stays as a direct-use
+    alias of ``random_map``).
 
     JPEG bytes take tf's fused partial decode (``decode_and_crop_jpeg``
     touches only the DCT blocks under the crop — the same C++ fast path
@@ -90,7 +102,7 @@ class DecodeAndAugment:
     train: bool
     dtype: Any
 
-    def __call__(self, record: dict, rng: np.random.Generator) -> dict:
+    def random_map(self, record: dict, rng: np.random.Generator) -> dict:
         data = record["bytes"]
         size = self.image_size
         if data[:3] == b"\xff\xd8\xff":  # JPEG magic
@@ -103,6 +115,8 @@ class DecodeAndAugment:
             STDDEV_RGB, np.float32)
         return {"image": arr.astype(self.dtype),
                 "label": record["label"]}
+
+    __call__ = random_map
 
     def _crop_box(self, rng, width: int, height: int):
         """(x, y, w, h) for this record: sampled for train, the padded

@@ -1,0 +1,378 @@
+"""ZeRO-1 sharded optimizer layout for the explicit-DP path.
+
+The bucketed ring all-reduce (parallel/collectives.py) already materializes
+the ZeRO-1 partition as its intermediate: after ``psum_scatter`` each shard
+holds the reduced 1/N chunk of every bucket, and the trailing ``all_gather``
+throws that structure away so every shard can run the SAME full optimizer
+update. ZeRO-1 (ZeRO stage 1, Rajbhandari et al.) keeps it instead: the
+optimizer update runs on each shard's chunk only, optimizer state lives
+permanently 1/N-sharded, and the ``all_gather`` moves the *updated
+parameters* rather than the summed gradients — identical communication
+volume (one reduce-scatter + one all-gather of the parameter bytes per
+step), optimizer HBM and update FLOPs divided by the DP degree.
+
+Layout: per-leaf chunking that PRESERVES the parameter treedef. Every leaf
+is raveled, zero-padded to a multiple of the axis size N, and split into N
+contiguous chunks; shard k owns elements ``[k*c, (k+1)*c)`` of every leaf.
+Keeping one chunk per leaf (instead of slicing the concatenated bucket)
+means the chunk tree has the same structure and relative magnitudes as the
+parameter tree, so path-keyed weight-decay masks apply unchanged and
+per-layer trust-ratio norms (LARS/LAMB) need only a cross-shard ``psum`` of
+squared sums (train/optim.py) to be exact. Bucket fusion is kept at the
+collective level: each fusion bucket's member leaves are packed into ONE
+``(N, row)`` payload — row k carrying every member's chunk k — so one
+``psum_scatter``/``all_gather`` launches per bucket, exactly like the fused
+all-reduce.
+
+Padding is benign through every supported optimizer: padded gradient
+elements are zero on all shards, so momentum/Adam moments stay zero, the
+update there is zero, and squared-sum norms gain nothing.
+
+Checkpoint compatibility (train/checkpoint.py): :class:`Zero1StateConverter`
+gathers the chunked optimizer state into the CANONICAL layout — each leaf
+restored to its parameter's shape, padding stripped — before save, and pads
+and re-shards on restore. The canonical layout is byte-identical to what the
+replicated path saves, so zero1 checkpoints restore replicated, replicated
+checkpoints restore into zero1, and the DP degree may change between save
+and resume (the pad is a function of N and is never persisted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearning_tpu.parallel.collectives import (
+    _MB, AxisNames, BucketPlan, DEFAULT_BUCKET_MB, _numel, plan_buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero1Layout:
+    """Chunk assignment for ONE parameter tree shape on ONE axis size.
+
+    ``chunk_sizes[i]`` is the per-shard chunk length of flatten-order leaf
+    i: ``ceil(numel_i / axis_size)``; the leaf's padded flat length is
+    ``chunk_sizes[i] * axis_size``. Bucket membership reuses the
+    deterministic path-keyed planner, so the payload layout is stable under
+    dict insertion-order churn exactly like the fused all-reduce.
+    """
+
+    plan: BucketPlan
+    axis_size: int
+    chunk_sizes: tuple[int, ...]
+
+    @property
+    def num_leaves(self) -> int:
+        return self.plan.num_leaves
+
+    def padded_size(self, i: int) -> int:
+        return self.chunk_sizes[i] * self.axis_size
+
+    def describe(self) -> str:
+        total = sum(_numel(s) for s in self.plan.shapes)
+        padded = sum(self.padded_size(i) for i in range(self.num_leaves))
+        return (f"1/{self.axis_size} per shard over "
+                f"{len(self.plan.buckets)} bucket(s), "
+                f"{self.num_leaves} leaves, pad {padded - total} elems")
+
+
+def build_layout(tree, axis_size: int,
+                 bucket_bytes: Optional[int] = None) -> Zero1Layout:
+    """Plan the ZeRO-1 chunk layout for ``tree`` (arrays or shape structs —
+    shapes are static, so this works on tracers at trace time)."""
+    if axis_size < 1:
+        raise ValueError(f"axis_size must be >= 1 (got {axis_size})")
+    plan = plan_buckets(tree, bucket_bytes)
+    chunk_sizes = tuple(-(-_numel(s) // axis_size) for s in plan.shapes)
+    return Zero1Layout(plan=plan, axis_size=axis_size,
+                       chunk_sizes=chunk_sizes)
+
+
+def layout_from_options(tree, axis_size: int, options=None
+                        ) -> tuple[Zero1Layout, Optional[Any]]:
+    """(layout, scatter payload dtype) per the run's AllReduceConfig —
+    the same bucket-size/dtype policy knobs the fused all-reduce reads.
+    The payload dtype applies to the gradient reduce-scatter only; the
+    parameter all-gather always moves the parameters' own dtype."""
+    bucket_mb = getattr(options, "bucket_mb", DEFAULT_BUCKET_MB)
+    dtype_name = getattr(options, "dtype", "float32") or "float32"
+    if dtype_name not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"allreduce dtype {dtype_name!r} not supported; use 'float32' "
+            f"(reduce in the gradients' own dtype) or 'bfloat16' "
+            f"(compressed payload, fp32 master restored after the reduce)")
+    payload = jnp.bfloat16 if dtype_name == "bfloat16" else None
+    return build_layout(tree, axis_size,
+                        int(float(bucket_mb) * _MB)), payload
+
+
+def _check_leaves(layout: Zero1Layout, n: int) -> None:
+    if n != layout.num_leaves:
+        raise ValueError(f"layout was built for {layout.num_leaves} leaves, "
+                         f"tree has {n}")
+
+
+def _pad_flat(leaf, padded: int):
+    flat = leaf.ravel()
+    pad = padded - flat.size
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+# ---------------------------------------------------------------------------
+# Global (full-array) layout conversions — used for optimizer-state init and
+# checkpoint reshard, OUTSIDE shard_map. The chunked global form of a leaf is
+# its zero-padded ravel of length chunk*N; placed with P(data, fsdp) on dim 0
+# it is exactly the concatenation of the shards' chunks.
+# ---------------------------------------------------------------------------
+
+def to_chunked(tree, layout: Zero1Layout):
+    """Each leaf -> its padded flat ``(chunk * N,)`` global form."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    _check_leaves(layout, len(leaves))
+    out = [_pad_flat(leaf, layout.padded_size(i))
+           for i, leaf in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def from_chunked(tree, layout: Zero1Layout):
+    """Inverse of :func:`to_chunked`: strip padding, restore leaf shapes."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    _check_leaves(layout, len(leaves))
+    out = []
+    for i, leaf in enumerate(leaves):
+        shape = layout.plan.shapes[i]
+        out.append(leaf[:_numel(shape)].reshape(shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def chunked_struct(tree, layout: Zero1Layout):
+    """ShapeDtypeStruct tree of the chunked global form (for eval_shape)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    _check_leaves(layout, len(leaves))
+    out = [jax.ShapeDtypeStruct((layout.padded_size(i),),
+                                jnp.dtype(layout.plan.dtypes[i]))
+           for i in range(len(leaves))]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Shard-local ops — call INSIDE shard_map.
+# ---------------------------------------------------------------------------
+
+def local_chunks(tree, layout: Zero1Layout, axis_names: AxisNames):
+    """This shard's contiguous 1/N chunk of every (padded, raveled) leaf."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    _check_leaves(layout, len(leaves))
+    idx = jax.lax.axis_index(axis_names)
+    out = []
+    for i, leaf in enumerate(leaves):
+        c = layout.chunk_sizes[i]
+        flat = _pad_flat(leaf, layout.padded_size(i))
+        out.append(jax.lax.dynamic_slice_in_dim(flat, idx * c, c, 0))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def reduce_scatter(tree, layout: Zero1Layout, axis_names: AxisNames, *,
+                   payload_dtype=None):
+    """Cross-shard SUM of every leaf, each shard keeping only its chunk.
+
+    One ``psum_scatter`` per fusion bucket: the bucket's member leaves are
+    packed as an ``(N, row)`` matrix whose row k holds every member's chunk
+    k, so the tiled scatter over the raveled payload hands shard k exactly
+    row k — its own chunk of every member — already reduced. This is the
+    first half of the ring all-reduce with the all-gather elided.
+
+    ``payload_dtype`` (bf16 compression) applies to the scatter payload
+    only; chunks are restored to each leaf's own dtype immediately after.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    _check_leaves(layout, len(leaves))
+    n = layout.axis_size
+    out: list[Any] = [None] * len(leaves)
+    for members in layout.plan.buckets:
+        common = (jnp.dtype(payload_dtype) if payload_dtype is not None
+                  else jnp.result_type(
+                      *(layout.plan.dtypes[i] for i in members)))
+        parts = []
+        for i in members:
+            flat = _pad_flat(leaves[i].astype(common), layout.padded_size(i))
+            parts.append(flat.reshape(n, layout.chunk_sizes[i]))
+        row = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        chunk = jax.lax.psum_scatter(row.reshape(-1), axis_names,
+                                     scatter_dimension=0, tiled=True)
+        off = 0
+        for i in members:
+            c = layout.chunk_sizes[i]
+            piece = jax.lax.dynamic_slice_in_dim(chunk, off, c, 0)
+            out[i] = piece.astype(layout.plan.dtypes[i])
+            off += c
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames):
+    """Reassemble full leaves from per-shard chunks (updated parameters).
+
+    One ``all_gather`` per fusion bucket — the second half of the ring
+    all-reduce, moved AFTER the optimizer update. The gathered ``(N*row,)``
+    payload reshapes to ``(N, row)`` with row k = shard k's chunks; slicing
+    a member's column block and raveling row-major restores its padded flat
+    leaf in natural order.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(chunks)
+    _check_leaves(layout, len(leaves))
+    n = layout.axis_size
+    out: list[Any] = [None] * len(leaves)
+    for members in layout.plan.buckets:
+        common = jnp.result_type(*(layout.plan.dtypes[i] for i in members))
+        parts = [leaves[i].astype(common) for i in members]
+        row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        full = jax.lax.all_gather(row, axis_names, tiled=True)
+        mat = full.reshape(n, -1)
+        off = 0
+        for i in members:
+            c = layout.chunk_sizes[i]
+            shape = layout.plan.shapes[i]
+            piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
+            out[i] = (piece.reshape(n * c)[:_numel(shape)].reshape(shape)
+                      .astype(layout.plan.dtypes[i]))
+            off += c
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer-state layout derivation. Which opt-state leaves mirror a
+# parameter leaf (momentum, Adam moments — chunked and sharded) vs carry
+# their own shape (step counters — replicated) is decided STRUCTURALLY: init
+# the optimizer abstractly against two probe trees with different leaf sizes
+# and mark the leaves whose shape follows the probe. Flatten order is
+# identical across inits of the same treedef, so index i of the chunked
+# template, the canonical template, and a live opt state all name the same
+# leaf — no shape-based guessing (a 1-D bias can collide with its own
+# padded-chunk length).
+# ---------------------------------------------------------------------------
+
+def _struct_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(tuple(x.shape), jnp.dtype(x.dtype)),
+        tree)
+
+
+def _probe_struct(tree, layout: Zero1Layout):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = [jax.ShapeDtypeStruct(
+        (layout.padded_size(i) + layout.axis_size,),
+        jnp.dtype(layout.plan.dtypes[i])) for i in range(len(leaves))]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _opt_templates(tx, params_struct, layout: Zero1Layout):
+    """(canonical flat, chunked flat, treedef, per-leaf chunked? mask)."""
+    params_struct = _struct_tree(params_struct)
+    canonical = jax.eval_shape(tx.init, params_struct)
+    chunked = jax.eval_shape(tx.init, chunked_struct(params_struct, layout))
+    probe = jax.eval_shape(tx.init, _probe_struct(params_struct, layout))
+    flat_canon, tdef_c = jax.tree_util.tree_flatten(canonical)
+    flat_chunk, tdef_k = jax.tree_util.tree_flatten(chunked)
+    flat_probe, _ = jax.tree_util.tree_flatten(probe)
+    if tdef_c != tdef_k:
+        raise ValueError(
+            "optimizer state structure depends on parameter leaf shapes; "
+            "the ZeRO-1 chunked<->canonical correspondence needs it to be "
+            f"shape-independent (canonical {tdef_c} vs chunked {tdef_k})")
+    mask = tuple(k.shape != p.shape
+                 for k, p in zip(flat_chunk, flat_probe))
+    return flat_canon, flat_chunk, tdef_c, mask
+
+
+def opt_state_specs(tx, params_struct, layout: Zero1Layout,
+                    chunk_spec, replicated_spec):
+    """Per-leaf PartitionSpec tree for the optimizer state: ``chunk_spec``
+    on chunked (parameter-mirroring) leaves, ``replicated_spec`` elsewhere
+    (step counters). Feeds shard_map in/out_specs and jit out_shardings."""
+    _, _, treedef, mask = _opt_templates(tx, params_struct, layout)
+    return jax.tree_util.tree_unflatten(
+        treedef, [chunk_spec if m else replicated_spec for m in mask])
+
+
+class Zero1StateConverter:
+    """Gather-on-save / reshard-on-restore for the chunked optimizer state.
+
+    ``to_canonical`` strips padding and restores each chunked opt-state
+    leaf to its parameter's shape — the exact layout the replicated path
+    saves, so checkpoints are interchangeable between ``none`` and
+    ``zero1`` and across DP degrees. ``from_canonical`` re-pads for the
+    CURRENT layout and places chunk leaves sharded over the DP axes.
+    ``canonical_abstract`` describes the on-disk layout for orbax's
+    structure-matched restore (replicated placement; the reshard happens in
+    ``from_canonical`` right after).
+    """
+
+    def __init__(self, tx, params_struct, layout: Zero1Layout, mesh,
+                 axis_names: AxisNames):
+        self.layout = layout
+        self._flat_canon, self._flat_chunk, self._treedef, self._mask = (
+            _opt_templates(tx, params_struct, layout))
+        self._rep = NamedSharding(mesh, P())
+        self._chunk_shd = NamedSharding(mesh, P(axis_names))
+
+    def _flat(self, opt_state):
+        flat, treedef = jax.tree_util.tree_flatten(opt_state)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"optimizer state structure does not match the converter's "
+                f"template: {treedef} vs {self._treedef}")
+        return flat
+
+    def _opt_to_canonical(self, opt_state):
+        out = []
+        for leaf, m, canon in zip(self._flat(opt_state), self._mask,
+                                  self._flat_canon):
+            out.append(leaf[:_numel(canon.shape)].reshape(canon.shape)
+                       if m else leaf)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def _opt_from_canonical(self, opt_state):
+        out = []
+        for leaf, m, chunk in zip(self._flat(opt_state), self._mask,
+                                  self._flat_chunk):
+            out.append(_pad_flat(leaf, chunk.shape[0]) if m else leaf)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def opt_shardings(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef,
+            [self._chunk_shd if m else self._rep for m in self._mask])
+
+    def to_canonical(self, state):
+        """TrainState with the opt state gathered to canonical layout."""
+        return jax.jit(lambda s: s.replace(
+            opt_state=self._opt_to_canonical(s.opt_state)))(state)
+
+    def from_canonical(self, state):
+        """TrainState with the opt state padded + sharded for this layout."""
+        shardings = jax.tree_util.tree_map(lambda _: self._rep, state)
+        shardings = shardings.replace(opt_state=self.opt_shardings())
+        return jax.jit(
+            lambda s: s.replace(
+                opt_state=self._opt_from_canonical(s.opt_state)),
+            out_shardings=shardings)(state)
+
+    def canonical_abstract(self, state_like):
+        """``state_like`` with the opt state replaced by the canonical
+        (on-disk) layout as sharding-carrying ShapeDtypeStructs."""
+        out = []
+        for leaf, m, canon in zip(self._flat(state_like.opt_state),
+                                  self._mask, self._flat_canon):
+            if m:
+                out.append(jax.ShapeDtypeStruct(canon.shape, canon.dtype,
+                                                sharding=self._rep))
+            else:
+                out.append(jax.ShapeDtypeStruct(
+                    tuple(leaf.shape), leaf.dtype,
+                    sharding=getattr(leaf, "sharding", self._rep)))
+        return state_like.replace(opt_state=jax.tree_util.tree_unflatten(
+            self._treedef, out))

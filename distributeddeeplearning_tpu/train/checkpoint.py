@@ -39,22 +39,33 @@ class Checkpointer:
     Owns the save cadence (``checkpoint_every_steps``), keeps the last
     ``max_to_keep`` checkpoints, and exposes exactly the three operations the
     training loop needs: maybe_save / restore_latest / wait.
+
+    ``converter`` (ZeRO-1 runs only) is a
+    :class:`~distributeddeeplearning_tpu.parallel.zero.Zero1StateConverter`:
+    saves gather the 1/N-sharded optimizer state into the CANONICAL layout
+    (each leaf its parameter's shape, padding stripped — byte-identical to
+    what a replicated run saves), restores reshard it back for the current
+    layout. On-disk checkpoints therefore never depend on the run's
+    optimizer-sharding mode or DP degree.
     """
 
     def __init__(self, directory: str, *, every_steps: int,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, converter: Any = None):
         self.every_steps = max(int(every_steps), 1)
+        self._converter = converter
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),  # orbax rejects relative paths
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, enable_async_checkpointing=True))
 
     @classmethod
-    def create(cls, config: TrainConfig) -> Optional["Checkpointer"]:
+    def create(cls, config: TrainConfig,
+               converter: Any = None) -> Optional["Checkpointer"]:
         if not config.checkpoint_dir:
             return None
         return cls(config.checkpoint_dir,
-                   every_steps=config.checkpoint_every_steps)
+                   every_steps=config.checkpoint_every_steps,
+                   converter=converter)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -66,6 +77,10 @@ class Checkpointer:
             return False
         if self._mgr.latest_step() == step:
             return False
+        if self._converter is not None:
+            # Gather-on-save: persist the canonical (mode/degree-agnostic)
+            # optimizer-state layout.
+            state = self._converter.to_canonical(state)
         return self._mgr.save(step, args=ocp.args.StandardSave(state))
 
     def restore_latest(self, state_like: Any) -> Optional[Any]:
@@ -83,6 +98,11 @@ class Checkpointer:
         step = self._mgr.latest_step()
         if step is None:
             return None
+        if self._converter is not None:
+            # Restore targets the canonical on-disk layout (replicated),
+            # then reshard-on-restore pads + scatters the optimizer state
+            # back into the current run's chunked layout.
+            state_like = self._converter.canonical_abstract(state_like)
         want_ema = state_like.ema_params is not None
         ckpt_ema = self._ckpt_has_ema(step)
         if ckpt_ema is None:  # unreadable metadata: keep the strict restore
@@ -102,9 +122,15 @@ class Checkpointer:
                 f"run seeds it from init).")
             restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
                 _abstract_like(state_like.replace(ema_params=None))))
-            return restored.replace(ema_params=restored.params)
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_abstract_like(state_like)))
+            restored = restored.replace(ema_params=restored.params)
+            return self._from_canonical(restored)
+        return self._from_canonical(self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_abstract_like(state_like))))
+
+    def _from_canonical(self, restored: Any) -> Any:
+        if self._converter is None:
+            return restored
+        return self._converter.from_canonical(restored)
 
     def _ckpt_has_ema(self, step: int) -> Optional[bool]:
         """Whether checkpoint ``step`` carries real EMA arrays, from the
@@ -196,9 +222,16 @@ class Checkpointer:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(step)
+        restored = self._restore_raw(step)
         return self._restore_subtree(restored["params"], params_like,
                                      "params")
+
+    def _restore_raw(self, step: int) -> Any:
+        """Target-less restore of the raw checkpoint tree (host arrays).
+        This orbax version's ``restore(step)`` with no args needs a handler
+        registry to reconstruct the item; the explicit empty
+        ``StandardRestore`` asks for the tree as saved instead."""
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
 
     def restore_latest_for_eval(self, state_like: Any) -> Optional[Any]:
         """Restore params + BN statistics + step — everything inference
@@ -210,7 +243,7 @@ class Checkpointer:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(step)
+        restored = self._restore_raw(step)
         params = self._restore_subtree(restored["params"], state_like.params,
                                        "params")
         batch_stats = state_like.batch_stats

@@ -25,8 +25,10 @@ from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.parallel import zero as zerolib
 from distributeddeeplearning_tpu.train import checkpoint as ckptlib
 from distributeddeeplearning_tpu.train import optim, steps
+from distributeddeeplearning_tpu.train import state as statelib
 from distributeddeeplearning_tpu.train.state import TrainState
 from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
@@ -77,6 +79,16 @@ def build(config: TrainConfig, total_steps: int):
     checkpoint restore."""
     spec = model_spec(config.model)
     _ = config.per_device_batch  # early, friendly divisibility error
+    if config.optimizer_sharding not in ("none", "zero1"):
+        raise ValueError(
+            f"unknown optimizer_sharding {config.optimizer_sharding!r}; "
+            f"expected 'none' or 'zero1'")
+    if (config.optimizer_sharding == "zero1"
+            and uses_gspmd(config, spec.input_kind)):
+        raise ValueError(
+            "optimizer_sharding='zero1' applies to the explicit-DP "
+            "shard_map path only (image model, no tp/sp/fsdp axes); the "
+            "GSPMD path shards state via NamedSharding rules instead")
     if config.attention_impl == "flash" and config.parallel.seq > 1:
         raise ValueError(
             "attention_impl='flash' is incompatible with seq-axis "
@@ -151,9 +163,13 @@ def build(config: TrainConfig, total_steps: int):
             f"(e.g. bert_base_moe) whose expert count is divisible by the "
             f"mesh axis")
 
+    zero1 = config.optimizer_sharding == "zero1"
+    # Under ZeRO-1 the optimizer sees 1/N chunks, so its norm-based pieces
+    # (global clip, LARS/LAMB trust ratios) must psum over the DP axes.
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,
-        steps_per_epoch(config))
+        steps_per_epoch(config),
+        shard_axes=steps.DATA_AXES if zero1 else None)
     bn_batch = config.per_device_batch // max(config.grad_accum_steps, 1)
     if config.sync_bn:
         # SyncBN pools statistics across the DP shards: the effective
@@ -197,28 +213,53 @@ def build(config: TrainConfig, total_steps: int):
             model, tx, mesh, config, shardings, spec.input_kind,
             spec.objective)
     else:
-        def init_fn(rng):
+        def variables_fn(rng):
             if spec.input_kind == "tokens":
-                variables = model.init(
+                return model.init(
                     {"params": rng, "dropout": rng},
                     jnp.zeros((1, config.data.seq_len), jnp.int32),
                     train=False)
-            else:
-                size = config.data.image_size
-                variables = model.init(
-                    {"params": rng}, jnp.zeros((1, size, size, 3), dtype),
-                    train=False)
+            size = config.data.image_size
+            return model.init(
+                {"params": rng}, jnp.zeros((1, size, size, 3), dtype),
+                train=False)
+
+        replicated = shardlib.replicated(mesh)
+        layout = converter = None
+        if zero1:
+            dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
+            params_struct = jax.eval_shape(variables_fn, rng)["params"]
+            layout, _ = zerolib.layout_from_options(
+                params_struct, dp_size, options=config.allreduce)
+            converter = zerolib.Zero1StateConverter(
+                tx, params_struct, layout, mesh, steps.DATA_AXES)
+
+        def init_fn(rng):
+            variables = variables_fn(rng)
             params = variables["params"]
+            # ZeRO-1: optimizer state is born in the chunked global layout
+            # (each leaf padded+raveled to chunk*N); out_shardings below
+            # then scatter it 1/N per device — it is never materialized
+            # replicated.
+            opt_params = (zerolib.to_chunked(params, layout) if zero1
+                          else params)
             return TrainState.create(
-                params=params, opt_state=tx.init(params),
+                params=params, opt_state=tx.init(opt_params),
                 batch_stats=variables.get("batch_stats"),
                 ema_params=(params if config.optimizer.ema_decay > 0
                             else None))
 
-        replicated = shardlib.replicated(mesh)
-        state = jax.jit(init_fn, out_shardings=replicated)(rng)
+        if zero1:
+            abstract = jax.eval_shape(init_fn, rng)
+            out_shd = jax.tree_util.tree_map(lambda _: replicated, abstract)
+            out_shd = out_shd.replace(opt_state=converter.opt_shardings())
+        else:
+            out_shd = replicated
+        state = jax.jit(init_fn, out_shardings=out_shd)(rng)
         train_step = steps.make_dp_train_step(
-            model, tx, mesh, config, spec.input_kind, spec.objective)
+            model, tx, mesh, config, spec.input_kind, spec.objective,
+            state_like=state)
+        train_step.zero_converter = converter
 
     return mesh, model, batch_shd, state, train_step, sched, rng
 
@@ -245,7 +286,8 @@ def run(config: TrainConfig, *, total_steps: int,
     mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
 
-    ckpt = ckptlib.Checkpointer.create(config)
+    ckpt = ckptlib.Checkpointer.create(
+        config, converter=getattr(train_step, "zero_converter", None))
     try:
         return _run_inner(
             config, spec, mesh, model, batch_shd, state, train_step, sched,
@@ -270,7 +312,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # Pin the environment-dependent loader resolution to the checkpoint:
         # a resume that would silently switch pipelines (different shuffle
         # order) fails loudly instead (ADVICE r1 #1).
-        ckpt.verify_or_record_stream_meta({"loader": resolved_loader})
+        # opt_state_layout documents the on-disk optimizer-state format:
+        # ALWAYS canonical (parameter-shaped leaves) — zero1 runs gather on
+        # save (parallel/zero.py) — which is what makes checkpoints
+        # interchangeable across optimizer-sharding modes and DP degrees. A
+        # future layout change would clash here loudly instead of silently
+        # mis-restoring.
+        ckpt.verify_or_record_stream_meta({"loader": resolved_loader,
+                                           "opt_state_layout": "canonical"})
     if ckpt is not None and config.resume:
         # restore_for_eval: params/BN/step only, fresh optimizer state — an
         # eval-only consumer must not have to repeat the training run's
@@ -296,6 +345,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # stderr so harness consumers (bench.py) keep a clean stdout
         ar = ("" if uses_gspmd(config, spec.input_kind)
               else f" | allreduce: {config.allreduce.describe()}")
+        zl = getattr(train_step, "zero_layout", None)
+        if zl is not None:
+            ar += f" | opt-sharding: zero1 ({zl.describe()})"
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
               f"dtype={config.dtype} loader={resolved_loader}" + ar
@@ -457,9 +509,23 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         "start_step": start_step,
         "final_metrics": {k: float(v) for k, v in metrics.items()},
     }
-    hbm = _device_memory_stats()
+    hbm = _device_memory_stats(state)
     if hbm:
         summary["memory"] = hbm
+        if jax.process_index() == 0:
+            parts = []
+            if "peak_bytes_in_use" in hbm:
+                parts.append(
+                    f"peak_hbm={hbm['peak_bytes_in_use'] / 2**20:.1f}MiB")
+            for k in ("params_bytes_per_device",
+                      "opt_state_bytes_per_device",
+                      "ema_params_bytes_per_device"):
+                if k in hbm:
+                    parts.append(f"{k.split('_bytes')[0]}/dev="
+                                 f"{hbm[k] / 2**20:.2f}MiB")
+            if parts:
+                print("# memory: " + " ".join(parts),
+                      file=sys.stderr, flush=True)
     if t_timed is not None and timed_examples:
         elapsed = time.perf_counter() - t_timed
         summary["examples_per_sec"] = timed_examples / elapsed
@@ -483,20 +549,33 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     return summary
 
 
-def _device_memory_stats() -> Optional[dict]:
-    """Peak/current HBM on local device 0 (None where the backend doesn't
-    report, e.g. CPU). The observability counterpart of nvidia-smi in the
-    reference's stack."""
+def _device_memory_stats(state=None) -> Optional[dict]:
+    """Peak/current HBM on local device 0 (where the backend reports it;
+    CPU doesn't) plus — given the final ``state`` — the per-device resident
+    bytes of params / optimizer state / EMA, computed from the arrays'
+    actual shard placement. The state breakdown works on EVERY backend, so
+    the ZeRO-1 optimizer-memory win is measurable even on the
+    CPU/fake-device path where allocator peaks are unavailable. The
+    observability counterpart of nvidia-smi in the reference's stack."""
+    out: dict = {}
     try:
-        stats = jax.local_devices()[0].memory_stats()
+        stats = jax.local_devices()[0].memory_stats() or {}
     except Exception:
-        return None
-    if not stats:
-        return None
-    out = {}
+        stats = {}
     for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
         if key in stats:
             out[key] = int(stats[key])
+    if state is not None:
+        try:
+            dev = jax.local_devices()[0]
+            for name, tree in (("params", state.params),
+                               ("opt_state", state.opt_state),
+                               ("ema_params", state.ema_params)):
+                if tree is not None:
+                    out[f"{name}_bytes_per_device"] = (
+                        statelib.resident_bytes(tree, dev))
+        except Exception:
+            pass
     return out or None
 
 

@@ -9,6 +9,15 @@ for large-batch BERT.
 Weight decay is masked off BatchNorm/LayerNorm parameters and biases — the
 standard large-batch convention; for LARS the same mask also disables the
 trust-ratio rescaling on those leaves.
+
+ZeRO-1 (``shard_axes``): under optimizer sharding (parallel/zero.py) the
+transformation sees each leaf's 1/N *chunk* instead of the full leaf.
+Elementwise transforms (momentum, Adam moments, decoupled weight decay)
+are unaffected — same treedef, same per-element math, zero padding inert.
+Only NORMS see partial data, so the two norm consumers get sharded mirrors
+here: global-norm clipping and the LARS/LAMB per-leaf trust ratios compute
+``sqrt(psum(sum(x^2)))`` over the DP axes, reproducing the full-leaf norm
+exactly (up to fp summation order).
 """
 
 from __future__ import annotations
@@ -16,6 +25,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -23,13 +33,23 @@ from distributeddeeplearning_tpu.config import OptimizerConfig
 
 
 def _decay_mask(params: Any) -> Any:
-    """True for leaves that get weight decay: kernels/embeddings only."""
-    flat = flax.traverse_util.flatten_dict(params)
+    """True for leaves that get weight decay: kernels/embeddings only.
+
+    Accepts frozen or plain nests uniformly and returns a mask with the
+    SAME treedef as the input — optax's masking zips mask and update trees,
+    so a plain-dict mask over FrozenDict params is a structure mismatch.
+    The mask keys on leaf *names*, which ZeRO-1 chunking preserves (the
+    chunk tree has the parameter treedef), so one mask serves both layouts.
+    """
+    frozen = isinstance(params, flax.core.FrozenDict)
+    flat = flax.traverse_util.flatten_dict(
+        flax.core.unfreeze(params) if frozen else params)
     mask = {
         path: (path[-1] == "kernel" or "embedding" in path[-1])
         for path in flat
     }
-    return flax.traverse_util.unflatten_dict(mask)
+    mask = flax.traverse_util.unflatten_dict(mask)
+    return flax.core.freeze(mask) if frozen else mask
 
 
 def scaled_lr(cfg: OptimizerConfig, global_batch: int) -> float:
@@ -65,9 +85,79 @@ def make_schedule(cfg: OptimizerConfig, global_batch: int,
     raise ValueError(f"unknown schedule {cfg.schedule!r}")
 
 
+# ---------------------------------------------------------------------------
+# Sharded-norm mirrors of optax's two norm consumers. Formula-identical to
+# optax.scale_by_trust_ratio / optax.clip_by_global_norm, with every
+# sum-of-squares psum'd over `axes` so each shard's partial leaf yields the
+# full-leaf norm. MUST be called inside shard_map over `axes`.
+# ---------------------------------------------------------------------------
+
+def _sharded_norm(x, axes) -> jax.Array:
+    return jnp.sqrt(jax.lax.psum(jnp.sum(jnp.square(x)), axes))
+
+
+def scale_by_trust_ratio_sharded(
+        axes, trust_coefficient: float = 1.0,
+        eps: float = 0.0) -> optax.GradientTransformation:
+    """optax.scale_by_trust_ratio over leaves sharded along ``axes``."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("scale_by_trust_ratio_sharded requires params")
+
+        def _scale_update(update, param):
+            # Mirrors optax: zero-norm params/updates fall back to ratio 1.
+            param_norm = _sharded_norm(param, axes)
+            update_norm = _sharded_norm(update, axes)
+            trust_ratio = trust_coefficient * param_norm / (update_norm + eps)
+            zero_norm = jnp.logical_or(param_norm == 0.0, update_norm == 0.0)
+            safe_trust_ratio = jnp.where(
+                zero_norm, jnp.array(1.0, dtype=param.dtype), trust_ratio)
+            return update * safe_trust_ratio
+
+        updates = jax.tree_util.tree_map(_scale_update, updates, params)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def clip_by_global_norm_sharded(max_norm: float,
+                                axes) -> optax.GradientTransformation:
+    """optax.clip_by_global_norm with the global norm psum'd over ``axes``."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        sq = sum(jnp.sum(jnp.square(u))
+                 for u in jax.tree_util.tree_leaves(updates))
+        g_norm = jnp.sqrt(jax.lax.psum(sq, axes))
+        trigger = jnp.squeeze(g_norm < max_norm)
+
+        def clip_fn(t):
+            return jax.lax.select(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm)
+
+        return jax.tree_util.tree_map(clip_fn, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
-                   steps_per_epoch: Optional[int] = None
+                   steps_per_epoch: Optional[int] = None,
+                   shard_axes=None
                    ) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Build the optimizer chain. ``shard_axes`` (ZeRO-1 only) names the
+    mesh axes the parameter chunks are sharded over; norm-based pieces then
+    use the sharded mirrors above, every elementwise piece is reused
+    verbatim, and the chain ORDER matches optax's stock composites exactly
+    so replicated and zero1 trajectories agree per element."""
     if not 0.0 <= cfg.ema_decay < 1.0:
         raise ValueError(
             f"ema_decay={cfg.ema_decay}: need 0 <= decay < 1 "
@@ -80,12 +170,26 @@ def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
             optax.sgd(sched, momentum=cfg.momentum, nesterov=False),
         )
     elif cfg.name == "lars":
-        tx = optax.lars(
-            sched, weight_decay=cfg.weight_decay,
-            weight_decay_mask=_decay_mask,
-            trust_coefficient=cfg.trust_coefficient,
-            trust_ratio_mask=_decay_mask,
-            momentum=cfg.momentum)
+        if shard_axes is None:
+            tx = optax.lars(
+                sched, weight_decay=cfg.weight_decay,
+                weight_decay_mask=_decay_mask,
+                trust_coefficient=cfg.trust_coefficient,
+                trust_ratio_mask=_decay_mask,
+                momentum=cfg.momentum)
+        else:
+            # optax.lars's exact chain with the trust-ratio norm psum'd.
+            tx = optax.chain(
+                optax.add_decayed_weights(cfg.weight_decay,
+                                          mask=_decay_mask),
+                optax.masked(
+                    scale_by_trust_ratio_sharded(
+                        shard_axes,
+                        trust_coefficient=cfg.trust_coefficient),
+                    mask=_decay_mask),
+                optax.scale_by_learning_rate(sched),
+                optax.trace(decay=cfg.momentum, nesterov=False),
+            )
     elif cfg.name == "adamw":
         tx = optax.adamw(
             sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
@@ -93,11 +197,25 @@ def make_optimizer(cfg: OptimizerConfig, global_batch: int, total_steps: int,
     elif cfg.name == "lamb":
         # Layer-wise Adam (You et al.) — the canonical large-batch BERT
         # optimizer, completing the pod-scale pair with LARS (CNNs).
-        tx = optax.lamb(
-            sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
-            weight_decay=cfg.weight_decay, mask=_decay_mask)
+        if shard_axes is None:
+            tx = optax.lamb(
+                sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+                weight_decay=cfg.weight_decay, mask=_decay_mask)
+        else:
+            # optax.lamb's exact chain with the trust-ratio norm psum'd.
+            tx = optax.chain(
+                optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps),
+                optax.add_decayed_weights(cfg.weight_decay,
+                                          mask=_decay_mask),
+                scale_by_trust_ratio_sharded(shard_axes),
+                optax.scale_by_learning_rate(sched),
+            )
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
     if cfg.grad_clip_norm:
-        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+        clip = (optax.clip_by_global_norm(cfg.grad_clip_norm)
+                if shard_axes is None
+                else clip_by_global_norm_sharded(cfg.grad_clip_norm,
+                                                 shard_axes))
+        tx = optax.chain(clip, tx)
     return tx, sched

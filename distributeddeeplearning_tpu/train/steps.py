@@ -37,6 +37,7 @@ from distributeddeeplearning_tpu import compat
 from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu.parallel import collectives
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
+from distributeddeeplearning_tpu.parallel import zero
 from distributeddeeplearning_tpu.parallel.mesh import use_mesh
 from distributeddeeplearning_tpu.train import losses
 from distributeddeeplearning_tpu.train.state import TrainState
@@ -194,7 +195,8 @@ def accumulated_grads(loss_fn, params, batch_stats, batch, rng, accum: int,
 
 def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        config: TrainConfig, input_kind: str = "image",
-                       objective: str = "classify"
+                       objective: str = "classify",
+                       state_like: Optional[TrainState] = None
                        ) -> Callable[[TrainState, Any, jax.Array],
                                      tuple[TrainState, dict]]:
     """Build the jitted data-parallel train step.
@@ -205,10 +207,34 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     psum-vs-ring) and divided by the shard count — the exact
     allreduce-average Horovod performs — so parameters stay bit-identical
     on every shard. BN running-stat updates are ``pmean``-ed likewise.
+
+    With ``config.optimizer_sharding == "zero1"`` the gradient sync stops at
+    the ring's halfway point: one ``psum_scatter`` per fusion bucket leaves
+    each shard holding the reduced 1/N chunk of every leaf, the optax update
+    runs on that chunk against permanently 1/N-sharded optimizer state
+    (parallel/zero.py), and the trailing ``all_gather`` moves the *updated
+    parameters* — same wire bytes as the ring all-reduce, optimizer
+    HBM/compute divided by the DP degree. ``state_like`` (the initialized
+    TrainState, chunked opt state included) is required then: it supplies
+    the per-leaf partition specs for shard_map.
     """
     loss_fn = loss_fn_for(model, input_kind, config, objective)
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
     accum = config.grad_accum_steps
+
+    zero1 = getattr(config, "optimizer_sharding", "none") == "zero1"
+    layout = payload = None
+    if zero1:
+        if state_like is None:
+            raise ValueError(
+                "optimizer_sharding='zero1' requires state_like= (the "
+                "initialized TrainState) so the step can derive the chunk "
+                "layout and per-leaf optimizer-state partition specs")
+        params_struct = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+            state_like.params)
+        layout, payload = zero.layout_from_options(
+            params_struct, dp_size, options=config.allreduce)
 
     def step_fn(state: TrainState, batch, rng):
         # Per-shard RNG: fold in the linearized DP coordinate.
@@ -222,25 +248,45 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             loss_fn, state.params, state.batch_stats, batch, rng, accum,
             vary_axes=DATA_AXES)
 
-        # The allreduce. compat.shard_map runs with replication checking OFF,
-        # so autodiff does NOT auto-psum gradients for the replicated params
-        # — `grads` arrives here shard-LOCAL, and this train step owns the
-        # reduction schedule: leaves fuse into size-targeted buckets, one
-        # collective per bucket (Horovod tensor fusion), with each bucket an
-        # independent dataflow edge XLA can overlap with remaining backward
-        # compute. Dividing the sum by the shard count turns the
-        # ring-allreduce-sum into the gradient *average* hvd applies.
-        grads = collectives.all_reduce_gradients(
-            grads, DATA_AXES, axis_size=dp_size, options=config.allreduce)
-        grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
         metrics = jax.lax.pmean(metrics, DATA_AXES)
         if new_bn is not None:
             # Sync running statistics (cheap; normalization itself stayed
             # local per shard, matching per-GPU BN under Horovod).
             new_bn = jax.lax.pmean(new_bn, DATA_AXES)
 
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1:
+            # ZeRO-1: reduce-scatter (the ring's first half), shard-local
+            # optimizer update on this shard's 1/N chunk of every leaf, then
+            # all-gather the UPDATED parameters (the ring's second half,
+            # moved past the update). `tx` was built with shard_axes=
+            # DATA_AXES (train/optim.py), so any cross-leaf norms (global
+            # clip, LARS/LAMB trust ratios) psum their squared sums and the
+            # chunked update matches the replicated one per element.
+            gchunks = zero.reduce_scatter(grads, layout, DATA_AXES,
+                                          payload_dtype=payload)
+            gchunks = jax.tree_util.tree_map(lambda g: g / dp_size, gchunks)
+            pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
+            updates, new_opt = tx.update(gchunks, state.opt_state, pchunks)
+            new_pchunks = optax.apply_updates(pchunks, updates)
+            new_params = zero.all_gather_chunks(new_pchunks, layout,
+                                                DATA_AXES)
+        else:
+            # The allreduce. compat.shard_map runs with replication checking
+            # OFF, so autodiff does NOT auto-psum gradients for the
+            # replicated params — `grads` arrives here shard-LOCAL, and this
+            # train step owns the reduction schedule: leaves fuse into
+            # size-targeted buckets, one collective per bucket (Horovod
+            # tensor fusion), with each bucket an independent dataflow edge
+            # XLA can overlap with remaining backward compute. Dividing the
+            # sum by the shard count turns the ring-allreduce-sum into the
+            # gradient *average* hvd applies.
+            grads = collectives.all_reduce_gradients(
+                grads, DATA_AXES, axis_size=dp_size,
+                options=config.allreduce)
+            grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+
         new_ema = _ema_update(state.ema_params, new_params,
                               config.optimizer.ema_decay)
         new_state = TrainState(step=state.step + 1, params=new_params,
@@ -249,10 +295,19 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         return new_state, metrics
 
     batch_spec = P(DATA_AXES)
+    if zero1:
+        # Everything replicated EXCEPT the chunked optimizer-state leaves,
+        # which shard dim 0 over the DP axes (each shard sees its chunk).
+        opt_spec = zero.opt_state_specs(tx, state_like.params, layout,
+                                        P(DATA_AXES), P())
+        state_spec = jax.tree_util.tree_map(lambda _: P(), state_like)
+        state_spec = state_spec.replace(opt_state=opt_spec)
+    else:
+        state_spec = P()
     mapped = compat.shard_map(
         step_fn, mesh=mesh,
-        in_specs=(P(), batch_spec, P()),
-        out_specs=(P(), P()))
+        in_specs=(state_spec, batch_spec, P()),
+        out_specs=(state_spec, P()))
     jitted = jax.jit(mapped, donate_argnums=0)
 
     def compiled(state, batch, rng):
@@ -261,6 +316,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     # Raw traceable step for the fused multi-step loop
     # (make_fused_train_loop): shard_map composes under an outer jit+scan.
     compiled.raw_step = mapped
+    compiled.zero_layout = layout
     return compiled
 
 

@@ -28,3 +28,39 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
     return devs
+
+
+# --- slow-marker audit (tools/marker_audit.py) -----------------------------
+# The tier-1 budget (870 s, ROADMAP) only holds if every long test carries
+# @pytest.mark.slow. Each run records (nodeid, call duration, slow?) and
+# prints offenders in the terminal summary; MARKER_AUDIT_JSON=<path> dumps
+# the records for tools/marker_audit.py to gate on in CI.
+
+_audit_records = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call":
+        return
+    _audit_records.append({
+        "nodeid": report.nodeid,
+        "duration": report.duration,
+        "slow": "slow" in report.keywords,
+    })
+
+
+def pytest_terminal_summary(terminalreporter):
+    import json
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.marker_audit import BUDGET_NOTE, find_violations
+
+    out = os.environ.get("MARKER_AUDIT_JSON")
+    if out:
+        with open(out, "w") as f:
+            json.dump(_audit_records, f)
+    for rec in find_violations(_audit_records):
+        terminalreporter.write_line(
+            f"MARKER-AUDIT: {rec['nodeid']} took {rec['duration']:.1f}s "
+            f"without @pytest.mark.slow ({BUDGET_NOTE})", yellow=True)

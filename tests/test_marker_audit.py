@@ -1,0 +1,57 @@
+"""Marker audit (tools/marker_audit.py): the tier-1 budget gate itself."""
+
+import json
+import subprocess
+import sys
+
+from tools.marker_audit import DEFAULT_THRESHOLD_S, find_violations
+
+
+def _rec(nodeid, duration, slow=False):
+    return {"nodeid": nodeid, "duration": duration, "slow": slow}
+
+
+def test_fast_and_marked_tests_pass():
+    records = [
+        _rec("tests/test_a.py::fast", 0.5),
+        _rec("tests/test_a.py::near_limit", DEFAULT_THRESHOLD_S),  # <=, not <
+        _rec("tests/test_b.py::marked_slow", 300.0, slow=True),
+    ]
+    assert find_violations(records) == []
+
+
+def test_unmarked_slow_test_flagged_slowest_first():
+    records = [
+        _rec("tests/test_a.py::bad", 75.0),
+        _rec("tests/test_a.py::worse", 120.0),
+        _rec("tests/test_a.py::ok", 1.0),
+    ]
+    got = find_violations(records)
+    assert [r["nodeid"] for r in got] == ["tests/test_a.py::worse",
+                                          "tests/test_a.py::bad"]
+
+
+def test_custom_threshold_and_malformed_records_skipped():
+    records = [
+        _rec("tests/test_a.py::t", 10.0),
+        {"nodeid": "tests/test_a.py::no_duration", "slow": False},
+        {"duration": "not-a-number", "slow": False, "nodeid": "x"},
+    ]
+    assert find_violations(records, threshold_s=5.0) == [records[0]]
+    assert find_violations(records) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps([_rec("t::fast", 1.0)]))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([_rec("t::unmarked", 200.0)]))
+    cmd = [sys.executable, "tools/marker_audit.py"]
+    assert subprocess.run(cmd + [str(ok)]).returncode == 0
+    proc = subprocess.run(cmd + [str(bad)], capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "t::unmarked" in proc.stdout
+    assert subprocess.run(cmd + [str(tmp_path / "missing.json")],
+                          capture_output=True).returncode == 2
+    # threshold override: 200s is fine under a 600s threshold
+    assert subprocess.run(cmd + [str(bad), "600"]).returncode == 0

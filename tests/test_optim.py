@@ -54,6 +54,48 @@ def test_decay_mask_excludes_bn_and_bias():
     assert mask["word_embeddings"] is True
 
 
+def test_decay_mask_frozen_matches_plain():
+    """FrozenDict and plain-dict params produce the SAME decisions and a
+    mask with the SAME treedef as the input — optax's masking zips mask
+    and update trees, so a plain mask over frozen params is a structure
+    mismatch (the flax .init default before unfreezing)."""
+    import flax
+
+    plain = {
+        "embed": {"embedding": jnp.ones((10, 4))},          # nn.Embed name
+        "block": {"conv": {"kernel": jnp.ones((3, 3, 1, 1))},
+                  "norm": {"scale": jnp.ones((4,)),
+                           "bias": jnp.zeros((4,))}},
+        "head": {"kernel": jnp.ones((4, 2)), "bias": jnp.zeros((2,))},
+    }
+    frozen = flax.core.freeze(plain)
+    m_plain = optim._decay_mask(plain)
+    m_frozen = optim._decay_mask(frozen)
+
+    assert isinstance(m_frozen, flax.core.FrozenDict)
+    assert (jax.tree_util.tree_structure(m_plain)
+            == jax.tree_util.tree_structure(plain))
+    assert (jax.tree_util.tree_structure(m_frozen)
+            == jax.tree_util.tree_structure(frozen))
+    # identical per-leaf decisions either way
+    assert (jax.tree_util.tree_leaves(m_plain)
+            == jax.tree_util.tree_leaves(m_frozen))
+    # decay on kernels/embeddings, none on norm scales or any bias
+    assert m_frozen["embed"]["embedding"] is True
+    assert m_frozen["block"]["conv"]["kernel"] is True
+    assert m_frozen["block"]["norm"]["scale"] is False
+    assert m_frozen["block"]["norm"]["bias"] is False
+    assert m_frozen["head"]["kernel"] is True
+    assert m_frozen["head"]["bias"] is False
+    # and optax accepts the frozen mask against frozen params end-to-end
+    import optax
+    tx = optax.add_decayed_weights(0.1, mask=optim._decay_mask)
+    updates, _ = tx.update(jax.tree_util.tree_map(jnp.zeros_like, frozen),
+                           tx.init(frozen), frozen)
+    assert float(jnp.abs(updates["block"]["norm"]["scale"]).max()) == 0.0
+    assert float(jnp.abs(updates["head"]["kernel"]).max()) > 0.0
+
+
 def test_lars_trust_ratio_toy():
     """LARS update magnitude ~ lr * trust_coeff * ||w|| / ||g|| * ||g||."""
     import optax

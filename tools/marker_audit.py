@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Gate the tier-1 wall budget: fail if an unmarked test runs too long.
+
+Tier-1 (``pytest -m 'not slow'``) has an 870 s budget on the 1-vCPU test
+box; a single unmarked ~60 s+ test silently eats 7% of it and the budget
+erodes one PR at a time. The audit closes that loop:
+
+1. tests/conftest.py records every test's call duration each run and, when
+   ``MARKER_AUDIT_JSON=<path>`` is set, dumps the records there.
+2. This script reads the dump and exits 1 listing every test that exceeded
+   the threshold without ``@pytest.mark.slow`` — chain it after pytest::
+
+       MARKER_AUDIT_JSON=/tmp/durations.json pytest tests/ -m 'not slow'
+       python tools/marker_audit.py /tmp/durations.json
+
+The threshold (default 60 s) is deliberately far above any healthy tier-1
+test here (slowest observed ~35 s) and far below the budget, so it only
+trips on genuine misclassification, not machine jitter. Tests already
+marked slow are never violations regardless of duration.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+DEFAULT_THRESHOLD_S = 60.0
+BUDGET_NOTE = "tier-1 budget 870s; mark tests >60s @pytest.mark.slow"
+
+
+def find_violations(records, threshold_s: float = DEFAULT_THRESHOLD_S):
+    """Records exceeding ``threshold_s`` without the slow marker.
+
+    ``records``: iterables of dicts with ``nodeid``, ``duration`` (seconds,
+    call phase only — setup/teardown cost is fixture-shared and not the
+    test author's marker decision), ``slow`` (bool). Malformed entries are
+    skipped rather than crashing the gate; sorted slowest-first.
+    """
+    out = []
+    for rec in records:
+        try:
+            if rec["slow"] or float(rec["duration"]) <= threshold_s:
+                continue
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.append(rec)
+    return sorted(out, key=lambda r: -float(r["duration"]))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print(f"usage: marker_audit.py <durations.json> [threshold_s="
+              f"{DEFAULT_THRESHOLD_S:g}]")
+        return 0 if argv else 2
+    threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
+    try:
+        with open(argv[0]) as f:
+            records = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"marker-audit: cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 2
+    violations = find_violations(records, threshold)
+    if not violations:
+        print(f"marker-audit: OK — {len(records)} tests, none over "
+              f"{threshold:g}s unmarked")
+        return 0
+    print(f"marker-audit: {len(violations)} test(s) over {threshold:g}s "
+          f"without @pytest.mark.slow ({BUDGET_NOTE}):")
+    for rec in violations:
+        print(f"  {rec['duration']:7.1f}s  {rec['nodeid']}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="per-bucket collective: one psum, or the "
                         "bandwidth-optimal psum_scatter+all_gather ring "
                         "form")
+    p.add_argument("--optimizer-sharding", default=None,
+                   choices=["none", "zero1"],
+                   help="ZeRO-1 optimizer-state sharding for the explicit-"
+                        "DP path: reduce-scatter grads, update each "
+                        "shard's 1/N param chunk against permanently "
+                        "sharded optimizer state, all-gather updated "
+                        "params — same comm volume as the ring all-reduce, "
+                        "optimizer HBM divided by the DP degree "
+                        "(parallel/zero.py)")
     p.add_argument("--sync-bn", action="store_true", default=None,
                    help="cross-replica BatchNorm statistics (psum over the "
                         "data axis, torch SyncBatchNorm semantics; pure-DP "
@@ -252,6 +261,8 @@ def build_config(args: argparse.Namespace):
     if ar_updates:
         cfg = cfg.replace(
             allreduce=dataclasses.replace(cfg.allreduce, **ar_updates))
+    if args.optimizer_sharding:
+        cfg = cfg.replace(optimizer_sharding=args.optimizer_sharding)
     if args.ema_decay is not None:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, ema_decay=args.ema_decay))

@@ -594,6 +594,92 @@ def _emit_error(args, msg: str) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _run_chaos(args) -> int:
+    """Chaos recovery benchmark (CPU, no chip needed): run the same tiny
+    synthetic job twice — once clean, once killed by fault injection at
+    step F under ``launch.py --max-restarts 1`` — and report the wall-clock
+    overhead of surviving one fault (relaunch + backend re-init +
+    re-compile + checkpoint restore + replayed steps). Deterministic on
+    purpose: ``crash@F`` is attempt-scoped (robustness/faults.py), so the
+    restarted attempt runs fault-free to completion."""
+    import shutil
+    import tempfile
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    steps, fail_at, every = args.chaos_steps, args.chaos_fail_at, 2
+    metric = "chaos_recovery_overhead"
+    if not 0 < fail_at < steps:
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": "s per fault",
+            "error": f"--chaos-fail-at must be in (0, {steps})"}),
+            flush=True)
+        return 0
+    root = tempfile.mkdtemp(prefix="ddl_chaos_")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def train_cmd(ckpt_dir: str, extra: tuple = ()) -> list[str]:
+        return [sys.executable, os.path.join(base, "train.py"),
+                "--backend", "cpu", "--synthetic",
+                "--model", "resnet18_thin", "--image-size", "32",
+                "--batch-size", "8", "--dtype", "float32",
+                "--steps", str(steps), "--checkpoint-every", str(every),
+                "--log-every", "1000", "--checkpoint-dir", ckpt_dir,
+                *extra]
+
+    def fail(stage: str, proc) -> int:
+        tail = (proc.stderr or "")[-600:]
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": "s per fault",
+            "error": f"{stage} run failed rc={proc.returncode}: {tail}"}),
+            flush=True)
+        return 0
+
+    try:
+        t0 = time.monotonic()
+        clean = subprocess.run(
+            train_cmd(os.path.join(root, "clean")), env=env,
+            capture_output=True, text=True, timeout=420)
+        w_clean = time.monotonic() - t0
+        if clean.returncode != 0:
+            return fail("clean", clean)
+
+        launch_cmd = [sys.executable, os.path.join(base, "launch.py"),
+                      "--num-processes", "1", "--max-restarts", "1",
+                      "--backoff", "0.2", "--",
+                      *train_cmd(os.path.join(root, "faulted"),
+                                 ("--fault-plan", f"crash@{fail_at}"))]
+        t1 = time.monotonic()
+        faulted = subprocess.run(launch_cmd, env=env, capture_output=True,
+                                 text=True, timeout=420)
+        w_faulted = time.monotonic() - t1
+        if faulted.returncode != 0 or "restart 1/1" not in faulted.stderr:
+            return fail("faulted", faulted)
+
+        # Checkpoint cadence fixes the resume point analytically: the loop
+        # saves at step F before the injector kills it only when F is on
+        # cadence, so the restart replays F - floor(F/every)*every steps.
+        resumed_from = (fail_at // every) * every
+        print(json.dumps({
+            "metric": metric,
+            "value": round(w_faulted - w_clean, 2),
+            "unit": "s per fault",
+            "vs_baseline": None,
+            "steps_lost": fail_at - resumed_from,
+            "restarts": 1,
+            "clean_s": round(w_clean, 1),
+            "faulted_s": round(w_faulted, 1),
+            "protocol": (f"cpu resnet18_thin b8 {steps} steps, "
+                         f"crash@{fail_at}, ckpt every {every}; overhead = "
+                         f"relaunch + re-init + re-compile + restore + "
+                         f"{fail_at - resumed_from} replayed step(s)"),
+        }), flush=True)
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _parse_record(line: str):
     """A parseable bench record (measurement or per-config error), or None."""
     if not line.startswith("{"):
@@ -783,8 +869,20 @@ def main(argv=None) -> int:
                    help="total wall-clock budget across all attempts (s); "
                         "guarantees the error record is printed before any "
                         "outer driver timeout can strike")
+    p.add_argument("--chaos", action="store_true",
+                   help="CPU recovery-overhead benchmark: time a clean tiny "
+                        "run vs the same run crashed at --chaos-fail-at and "
+                        "auto-restarted by launch.py; emits one "
+                        "chaos_recovery_overhead record (no chip needed)")
+    p.add_argument("--chaos-steps", type=int, default=8,
+                   help="total steps of each --chaos run")
+    p.add_argument("--chaos-fail-at", type=int, default=5,
+                   help="step after which the faulted --chaos run crashes")
     p.add_argument("--run-child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    if args.chaos:
+        return _run_chaos(args)
 
     if args.fused_conv3 and not args.fused_block:
         # Same up-front reject as train.py: on a scarce chip window this

@@ -92,6 +92,13 @@ class DataConfig:
     prefetch_depth: int = 2       # StreamSource lookahead batches (host->HBM
                                   # pipelining; also the native loader's
                                   # batch-slot ring depth - 1)
+    # Per-batch loader watchdog for host-streaming sources (tf/native/
+    # grain/tokens): a pull that exceeds the timeout is retried (with a
+    # loud warning) up to loader_retries times, then the run dies with a
+    # clear "loader stalled" error instead of hanging the collective step
+    # on every host. 0 = watchdog off (docs/fault_tolerance.md).
+    loader_timeout_s: float = 0.0
+    loader_retries: int = 2
     # BERT-style sequence workloads:
     seq_len: int = 128
     vocab_size: int = 30522
@@ -159,7 +166,28 @@ class TrainConfig:
     resume: bool = True
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
-    fail_at_step: Optional[int] = None  # fault injection (SURVEY.md §5.3)
+    fail_at_step: Optional[int] = None  # DEPRECATED single-fault injection:
+                                  # shimmed to fault_plan "crash@N:always"
+                                  # (robustness/faults.py); kept so existing
+                                  # flags/scripts run unchanged
+    fault_plan: Optional[str] = None  # scheduled fault injection, e.g.
+                                  # "nan_grads@5,corrupt_latest_ckpt@6,
+                                  # sigkill@6" — grammar and semantics in
+                                  # robustness/faults.py and
+                                  # docs/fault_tolerance.md. None = zero
+                                  # injection code anywhere on the hot path
+    bad_step_guard: bool = False  # compile the non-finite-update skip guard
+                                  # into the train step (auto-on when the
+                                  # fault plan injects nan_grads). Opt-in
+                                  # because the skip-select keeps pre-update
+                                  # buffers alive, which re-fuses the XLA
+                                  # program ~1 ULP off the guard-free (and
+                                  # zero1-bitwise-pinned) trajectory
+    bad_step_limit: int = 10      # abort after K CONSECUTIVE non-finite
+                                  # (skipped) update steps — one bad batch
+                                  # is skipped and counted, a divergent run
+                                  # dies loudly instead of burning the
+                                  # budget on no-op steps
     attention_impl: Optional[str] = None  # None=default; dense|ring|flash
     remat: bool = False           # recompute transformer-layer activations
                                   # in backward (less HBM, ~1/3 more FLOPs)

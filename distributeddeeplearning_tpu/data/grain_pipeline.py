@@ -35,6 +35,7 @@ import numpy as np
 from distributeddeeplearning_tpu.config import DataConfig, TrainConfig
 from distributeddeeplearning_tpu.data.imagenet import (
     CROP_PADDING, MEAN_RGB, STDDEV_RGB, StreamSource, _per_process_batch,
+    stream_guard_kwargs,
     folder_index)
 
 # grain dispatches two-arg random_map(record, rng) ONLY to isinstance
@@ -229,4 +230,5 @@ def make_grain_source(config: TrainConfig, sharding, *, train: bool = True,
         hint = n_local // _per_process_batch(config, jax.process_count())
     return StreamSource(iter(ds), sharding, first_step=start_step,
                         depth=config.data.prefetch_depth,
-                        batches_hint=hint)
+                        batches_hint=hint,
+                        **stream_guard_kwargs(config, train=train))

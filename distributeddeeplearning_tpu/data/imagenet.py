@@ -34,6 +34,10 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import queue
+import sys
+import threading
+import time
 from typing import Any, Iterator, Optional
 
 import jax
@@ -264,6 +268,36 @@ def _per_process_batch(config: TrainConfig, process_count: int) -> int:
 # Source adapter (loop-facing)
 # ---------------------------------------------------------------------------
 
+class _ProducerError:
+    """Carrier moving a loader-thread exception to the consuming thread."""
+
+    def __init__(self, err: BaseException):
+        self.err = err
+
+
+def _stalling_iterator(it: Iterator[dict], stalls: dict[int, float],
+                       first_index: int) -> Iterator[dict]:
+    """Fault injection (robustness/faults.py ``loader_stall@N``): sleep
+    before yielding the batch destined for step N. Wraps the raw host
+    iterator so the stall is indistinguishable from a genuinely slow
+    pipeline — which is exactly what the watchdog must catch."""
+    idx = first_index
+    for item in it:
+        delay = stalls.get(idx)
+        if delay:
+            time.sleep(delay)
+        yield item
+        idx += 1
+
+
+def stream_guard_kwargs(config: TrainConfig, *, train: bool = True) -> dict:
+    """StreamSource watchdog/stall kwargs for a config — shared by every
+    host-streaming loader builder (tf/native/tokens/grain)."""
+    from distributeddeeplearning_tpu.robustness import faults
+
+    return faults.stream_guard_kwargs(config, train=train)
+
+
 class StreamSource:
     """Adapts a host-batch iterator to the loop's ``batch(step)`` protocol.
 
@@ -281,7 +315,11 @@ class StreamSource:
 
     def __init__(self, it: Iterator[dict], sharding, *, first_step: int = 0,
                  lookahead: bool = True, depth: int = 1,
-                 batches_hint: Optional[int] = None):
+                 batches_hint: Optional[int] = None,
+                 timeout_s: float = 0.0, max_retries: int = 2,
+                 stall_steps: Optional[dict] = None):
+        if stall_steps:
+            it = _stalling_iterator(it, dict(stall_steps), first_step)
         self._it = it
         self._sharding = sharding
         self._next_step = first_step
@@ -294,8 +332,29 @@ class StreamSource:
         # depth <= 0 (or lookahead=False) disables prefetch entirely —
         # batches are pulled on demand (used by short bounded evals).
         self._depth = max(depth, 0) if lookahead else 0
+        # Watchdog (DataConfig.loader_timeout_s > 0): host items flow
+        # through a bounded queue fed by a daemon thread, so a hung
+        # iterator surfaces as a timeout here instead of wedging the run
+        # silently. device_put stays on the consuming thread — only the
+        # host-side next() moves. Disabled by default: the hot path pulls
+        # straight from the iterator with zero extra machinery.
+        self._timeout_s = float(timeout_s)
+        self._max_retries = max(int(max_retries), 0)
+        self._q: Optional[queue.Queue] = None
+        if self._timeout_s > 0:
+            self._q = queue.Queue(maxsize=max(self._depth, 1) + 1)
+            threading.Thread(target=self._produce, args=(it,),
+                             name="ddl-loader", daemon=True).start()
         self._pending: list = []
         self._fill()
+
+    def _produce(self, it: Iterator[dict]) -> None:
+        try:
+            for item in it:
+                self._q.put(item)
+            self._q.put(self._EXHAUSTED)
+        except BaseException as e:  # ferried to the consumer, re-raised there
+            self._q.put(_ProducerError(e))
 
     def _fill(self) -> None:
         while (len(self._pending) < self._depth
@@ -307,10 +366,35 @@ class StreamSource:
         """Next device batch, or the _EXHAUSTED sentinel on a finite stream
         (eval split) running dry — deferred so batch k is still deliverable
         when the k+1 lookahead hits end-of-data."""
-        try:
-            return self._device_put(next(self._it))
-        except StopIteration:
+        if self._q is None:
+            try:
+                return self._device_put(next(self._it))
+            except StopIteration:
+                return self._EXHAUSTED
+        item = self._next_host_item()
+        if item is self._EXHAUSTED:
             return self._EXHAUSTED
+        return self._device_put(item)
+
+    def _next_host_item(self):
+        attempts = self._max_retries + 1
+        for attempt in range(attempts):
+            try:
+                item = self._q.get(timeout=self._timeout_s)
+            except queue.Empty:
+                print(f"# data watchdog: no host batch within "
+                      f"{self._timeout_s:.1f}s "
+                      f"(attempt {attempt + 1}/{attempts})",
+                      file=sys.stderr, flush=True)
+                continue
+            if isinstance(item, _ProducerError):
+                raise item.err
+            return item
+        raise RuntimeError(
+            f"data loader stalled: no host batch within {self._timeout_s:.1f}s"
+            f" across {attempts} attempts — the input pipeline is hung or "
+            "starved; restart the job (the launcher restart budget treats "
+            "this as a transient fault)")
 
     def _device_put(self, host_batch: dict) -> dict:
         def put(x):
@@ -357,4 +441,5 @@ def make_imagenet_source(config: TrainConfig, sharding, *, train: bool = True,
     return StreamSource(ds.as_numpy_iterator(), sharding,
                         first_step=start_step,
                         depth=config.data.prefetch_depth,
-                        batches_hint=hint)
+                        batches_hint=hint,
+                        **stream_guard_kwargs(config, train=train))

@@ -213,6 +213,7 @@ def make_native_source(config, sharding, *, train: bool = True,
         it = (cast(b) for b in it)
     src = imagenet.StreamSource(
         it, sharding, first_step=start_step, depth=d.prefetch_depth,
-        batches_hint=None if train else len(paths) // per_process)
+        batches_hint=None if train else len(paths) // per_process,
+        **imagenet.stream_guard_kwargs(config, train=train))
     src._native_loader = loader  # keep alive; closed on GC
     return src

@@ -21,7 +21,8 @@ import jax
 import numpy as np
 
 from distributeddeeplearning_tpu.config import TrainConfig
-from distributeddeeplearning_tpu.data.imagenet import StreamSource
+from distributeddeeplearning_tpu.data.imagenet import (
+    StreamSource, stream_guard_kwargs)
 from distributeddeeplearning_tpu.data.synthetic import MASK_TOKEN_ID
 
 # BERT-base uncased special ids; ids <= UNUSED_MAX are never masked targets.
@@ -177,4 +178,5 @@ def make_token_source(config: TrainConfig, sharding, *, start_step: int = 0,
     it = _batch_stream(config, train=train, start_step=start_step,
                        objective=objective)
     return StreamSource(it, sharding, first_step=start_step,
-                        depth=config.data.prefetch_depth)
+                        depth=config.data.prefetch_depth,
+                        **stream_guard_kwargs(config, train=train))

@@ -37,11 +37,18 @@ import signal
 import subprocess
 import sys
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
+
+from distributeddeeplearning_tpu.robustness import faults
 
 ENV_COORDINATOR = "DDL_COORDINATOR"
 ENV_NUM_PROCESSES = "DDL_NUM_PROCESSES"
 ENV_PROCESS_ID = "DDL_PROCESS_ID"
+
+# Exit codes that mean "the operator stopped the job", never "retry":
+# 130 = SIGINT via shell, 143 = SIGTERM via shell (128+15), -15 = SIGTERM
+# as reported by subprocess.Popen for a signal-killed child.
+_OPERATOR_STOP_RCS = (130, 143, -15)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,10 +143,23 @@ def monitor(children: Sequence[subprocess.Popen], *,
     try:
         while True:
             codes = [p.poll() for p in procs]
-            failed = [c for c in codes if c not in (None, 0)]
+            failed = [(i, c) for i, c in enumerate(codes)
+                      if c not in (None, 0)]
             if failed:
+                # Failure attribution BEFORE tearing the job down: once the
+                # survivors are terminated every child is "dead", and the
+                # operator can no longer tell the culprit from the victims.
+                for idx, c in failed:
+                    why = f" (killed by signal {-c})" if c < 0 else ""
+                    print(f"# launcher: child {idx} exited rc={c}{why}",
+                          file=sys.stderr, flush=True)
+                survivors = sum(1 for c in codes if c is None)
+                if survivors:
+                    print(f"# launcher: terminating {survivors} surviving "
+                          "child(ren) (fail-whole)",
+                          file=sys.stderr, flush=True)
                 _terminate_all(procs, grace_s)
-                return int(failed[0]) or 1
+                return int(failed[0][1]) or 1
             if all(c == 0 for c in codes):
                 return 0
             time.sleep(poll_interval_s)
@@ -162,35 +182,117 @@ def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float) -> None:
 
 
 def run_local(num_processes: int, command: Sequence[str], *,
-              port: int = 9531) -> int:
-    """Spawn + monitor N local processes (the `mpirun -np N` replacement)."""
+              port: int = 9531,
+              child_env: Optional[dict[int, dict[str, str]]] = None) -> int:
+    """Spawn + monitor N local processes (the `mpirun -np N` replacement).
+
+    ``child_env`` maps process_id → extra env vars for that child only —
+    how ``--child-fault-plan`` targets one rank of a simulated pod.
+    """
     specs = plan_local(num_processes, port=port)
-    children = [spawn(s, command) for s in specs]
+    children = [spawn(s, command, extra_env=(child_env or {}).get(
+        s.process_id)) for s in specs]
     return monitor(children)
 
 
+def _backoff_delay(attempt: int, base_s: float, cap_s: float) -> float:
+    """Exponential backoff with deterministic jitter.
+
+    Jitter de-synchronises many launchers restarting after a shared-cause
+    failure (coordinator blip) without randomness — a Knuth-hash fraction of
+    the attempt number, so reruns of the same job back off identically.
+    """
+    delay = base_s * 2.0 ** max(attempt - 1, 0)
+    frac = ((attempt * 2654435761) & 0xFFFFFFFF) / 2.0 ** 32
+    return min(delay * (1.0 + 0.25 * frac), cap_s)
+
+
+def _latest_ckpt_step(directory: str) -> Optional[int]:
+    """Largest numeric subdirectory of an orbax root, stdlib-only (the
+    launcher must not import jax/orbax — children own the accelerator)."""
+    try:
+        steps = [int(n) for n in os.listdir(directory) if n.isdigit()]
+    except OSError:
+        return None
+    return max(steps, default=None)
+
+
 def run_with_restarts(run_once, max_restarts: int, *,
-                      backoff_s: float = 3.0) -> int:
+                      backoff_s: float = 3.0,
+                      backoff_cap_s: float = 60.0,
+                      progress_fn: Optional[Callable[[], object]] = None,
+                      sleep=None) -> int:
     """Fail-whole + auto-relaunch: the in-launcher restart wrapper.
 
     The reference's failure story was "mpirun dies whole, Batch AI resubmits
     the job" (SURVEY.md §5.3); ``run_once`` is one whole-job attempt, and a
-    nonzero exit relaunches it up to ``max_restarts`` times. Paired with
-    checkpoint-resume (train/checkpoint.py restores latest and the data
-    stream repositions), each relaunch continues from the last saved step.
-    Interrupts (rc 130) are the operator stopping the job — never retried.
+    nonzero exit relaunches it with exponential backoff (``backoff_s``
+    doubling per consecutive failure, capped at ``backoff_cap_s``, with
+    deterministic jitter). Paired with checkpoint-resume
+    (train/checkpoint.py restores latest and the data stream repositions),
+    each relaunch continues from the last saved step.
+
+    ``max_restarts`` is a *restart budget between progress*, not a lifetime
+    total: when ``progress_fn`` (e.g. latest checkpoint step) returns a new
+    value after an attempt, the budget refills — a job that keeps advancing
+    survives any number of transient faults, while a crash-loop that never
+    reaches the next checkpoint exhausts the budget and stops. Without a
+    ``progress_fn`` the budget is a plain lifetime cap (old behaviour).
+
+    Operator stops (rc 130 = SIGINT, 143/-15 = SIGTERM) are never retried —
+    a preempted child that saved and exited via its SIGTERM handler, or an
+    operator ^C, must not resurrect the job.
+
+    Each attempt exports its index via ``DDL_FAULT_PLAN``'s companion env
+    (``DDL_RESTART_ATTEMPT``) so attempt-scoped fault injection
+    (robustness/faults.py) fires only on the intended attempt.
+
+    ``sleep`` is injectable for tests (defaults to ``time.sleep``).
     """
-    attempt = 0
-    while True:
-        rc = run_once()
-        if rc == 0 or rc == 130 or attempt >= max_restarts:
-            return rc
-        attempt += 1
-        print(f"# launcher: job failed (rc={rc}); restart "
-              f"{attempt}/{max_restarts} in {backoff_s:.0f}s "
-              f"(resumes from the latest checkpoint)",
-              file=sys.stderr, flush=True)
-        time.sleep(backoff_s)
+    do_sleep = sleep if sleep is not None else time.sleep
+    total = 0          # attempts so far (exported to children)
+    window_used = 0    # restarts consumed since the last observed progress
+    last_progress = progress_fn() if progress_fn is not None else None
+    prev_attempt = os.environ.get(faults.ENV_ATTEMPT)
+    try:
+        while True:
+            os.environ[faults.ENV_ATTEMPT] = str(total)
+            rc = run_once()
+            total += 1
+            if rc == 0:
+                return rc
+            if rc in _OPERATOR_STOP_RCS:
+                print(f"# launcher: operator stop (rc={rc}); not retrying",
+                      file=sys.stderr, flush=True)
+                return rc
+            if progress_fn is not None:
+                progress = progress_fn()
+                if progress != last_progress and window_used:
+                    print(f"# launcher: progress observed "
+                          f"({last_progress!r} -> {progress!r}); restart "
+                          "budget refilled",
+                          file=sys.stderr, flush=True)
+                    window_used = 0
+                last_progress = progress
+            if window_used >= max_restarts:
+                if progress_fn is not None and max_restarts > 0:
+                    print(f"# launcher: no progress across {window_used} "
+                          f"consecutive restarts (budget={max_restarts}) — "
+                          "crash loop, giving up",
+                          file=sys.stderr, flush=True)
+                return rc
+            window_used += 1
+            delay = _backoff_delay(window_used, backoff_s, backoff_cap_s)
+            print(f"# launcher: job failed (rc={rc}); restart "
+                  f"{window_used}/{max_restarts} in {delay:.1f}s "
+                  f"(resumes from the latest checkpoint)",
+                  file=sys.stderr, flush=True)
+            do_sleep(delay)
+    finally:
+        if prev_attempt is None:
+            os.environ.pop(faults.ENV_ATTEMPT, None)
+        else:
+            os.environ[faults.ENV_ATTEMPT] = prev_attempt
 
 
 def run_from_hostfile(path: str, process_id: int, command: Sequence[str], *,
@@ -221,7 +323,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="coordinator port")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="relaunch the whole job up to N times after a "
-                        "failure (resumes from the latest checkpoint)")
+                        "failure (resumes from the latest checkpoint); when "
+                        "the command names a --checkpoint-dir, N is a budget "
+                        "*between checkpoints* — progress refills it, a "
+                        "crash loop exhausts it")
+    p.add_argument("--backoff", type=float, default=3.0,
+                   help="base restart delay in seconds (doubles per "
+                        "consecutive failure, deterministic jitter)")
+    p.add_argument("--backoff-cap", type=float, default=60.0,
+                   help="maximum restart delay in seconds")
+    p.add_argument("--child-fault-plan", action="append", default=[],
+                   metavar="IDX:PLAN",
+                   help="inject a fault plan (robustness/faults.py grammar) "
+                        "into one local child, e.g. 0:sigkill@20 "
+                        "(repeatable; local --num-processes jobs only)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -235,6 +350,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.hostfile:
         if args.process_id is None:
             p.error("--hostfile requires --process-id")
+        if args.child_fault_plan:
+            p.error("--child-fault-plan only supports local "
+                    "(--num-processes) jobs")
         if args.max_restarts:
             # A per-host restart decision is wrong for a whole-job semantic:
             # hosts whose rank exited 0 would never relaunch, leaving the
@@ -247,8 +365,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_from_hostfile(args.hostfile, args.process_id, command,
                                  port=args.port)
     n = args.num_processes or 1
+
+    child_env: dict[int, dict[str, str]] = {}
+    for item in args.child_fault_plan:
+        idx_s, sep, plan = item.partition(":")
+        if not sep or not idx_s.isdigit():
+            p.error(f"--child-fault-plan expects IDX:PLAN, got {item!r}")
+        faults.parse_plan(plan)  # fail fast on grammar errors
+        child_env.setdefault(int(idx_s), {})[faults.ENV_PLAN] = plan
+
+    progress_fn = None
+    ckpt_dir = _checkpoint_dir_from_command(command)
+    if ckpt_dir is not None:
+        progress_fn = lambda: _latest_ckpt_step(ckpt_dir)  # noqa: E731
+
     return run_with_restarts(
-        lambda: run_local(n, command, port=args.port), args.max_restarts)
+        lambda: run_local(n, command, port=args.port, child_env=child_env),
+        args.max_restarts, backoff_s=args.backoff,
+        backoff_cap_s=args.backoff_cap, progress_fn=progress_fn)
+
+
+def _checkpoint_dir_from_command(command: Sequence[str]) -> Optional[str]:
+    """The training command's --checkpoint-dir, if present — lets the
+    restart budget observe progress (new checkpoint step => refill)."""
+    for i, tok in enumerate(command):
+        if tok == "--checkpoint-dir" and i + 1 < len(command):
+            return command[i + 1]
+        if tok.startswith("--checkpoint-dir="):
+            return tok.split("=", 1)[1]
+    return None
 
 
 if __name__ == "__main__":

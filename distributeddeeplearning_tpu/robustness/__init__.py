@@ -1,0 +1,3 @@
+"""Robustness tooling: deterministic fault injection (faults.py) and the
+chaos-harness helpers that exercise the recovery paths end-to-end
+(docs/fault_tolerance.md)."""

@@ -1,0 +1,311 @@
+"""Deterministic fault injection — the chaos side of the recovery story.
+
+The reference's failure model is "mpirun dies whole, the scheduler resubmits,
+training resumes from the last checkpoint" (SURVEY.md §5.3). The repo's
+recovery machinery (checkpoint-resume, the launcher's fail-whole monitor +
+restart wrapper, the loop's SIGTERM-save path, the bad-step guard, the loader
+watchdog) only earns trust if faults can be injected *deterministically* and
+*in combination* — a mid-run kill AND a corrupted checkpoint AND a NaN step
+in one scripted run. This module is that script.
+
+A **fault plan** is a comma-separated list of ``kind@step`` entries::
+
+    sigkill@6,corrupt_latest_ckpt@6,nan_grads@5
+    crash@3                      # SystemExit after completing step 3
+    loader_stall@4:2.5s          # the pull of batch 4 sleeps 2.5 s
+    sigterm@4:a1                 # fires on restart attempt 1 only
+    crash@3:always               # re-fires on every restart attempt
+
+Kinds
+-----
+- ``crash@N``      — raise SystemExit after completing step N (checkpoint
+  writes are awaited first, like the legacy ``--fail-at-step``).
+- ``sigterm@N``    — deliver SIGTERM to self after step N; exercises the
+  loop's preemption handler (save-at-next-boundary, then exit).
+- ``sigkill@N``    — SIGKILL to self after step N: a hard death with no
+  cleanup, the closest model of a preempted/failed host.
+- ``nan_grads@N``  — the compiled train step poisons the gradients of the
+  update that completes step N (compiled in ONLY when the plan asks for it,
+  so a plan-free run's hot path carries zero injection code).
+- ``loader_stall@N[:Ts]`` — the host-streaming data source sleeps T seconds
+  (default 5) before yielding the batch for step N; exercises the loader
+  watchdog.
+- ``corrupt_latest_ckpt@N`` — after step N (and after awaiting the async
+  save), garbage the newest committed checkpoint's files on disk, leaving
+  its commit marker intact so it still *looks* restorable; exercises the
+  restore path's quarantine-and-fall-back.
+
+Qualifiers (colon-separated, any order): ``aK`` — fire only on restart
+attempt K (the launcher's ``run_with_restarts`` exports the attempt index as
+``DDL_RESTART_ATTEMPT``); ``always`` — fire on every attempt; ``<float>s`` —
+stall duration for ``loader_stall``. Default is attempt 0 only, so a
+restarted job replays the step range clean — which is what lets the chaos
+soak (tests/test_faults.py) demand bitwise-identical final params vs a
+fault-free run.
+
+Plans come from ``--fault-plan`` / ``TrainConfig.fault_plan``, from the
+``DDL_FAULT_PLAN`` env var (the launcher's ``--child-fault-plan`` sets it
+per child, faulting one process of a multi-process job), and from the legacy
+``fail_at_step`` flag (shimmed to ``crash@N:always``, preserving its
+re-fires-on-resume semantics). This module is pure stdlib — the data
+pipeline, launcher, and train loop all import it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+ENV_PLAN = "DDL_FAULT_PLAN"
+ENV_ATTEMPT = "DDL_RESTART_ATTEMPT"
+
+ALWAYS = -1  # Fault.attempt sentinel: fire on every restart attempt
+
+KINDS = frozenset({
+    "crash", "sigterm", "sigkill", "nan_grads", "loader_stall",
+    "corrupt_latest_ckpt",
+})
+# Faults the train loop fires between steps (vs nan_grads: compiled into the
+# step; loader_stall: injected into the data source).
+_PROCESS_KINDS = frozenset({
+    "crash", "sigterm", "sigkill", "corrupt_latest_ckpt"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    step: int
+    attempt: int = 0      # restart attempt this fires on; ALWAYS = every one
+    seconds: float = 5.0  # loader_stall duration
+
+    def describe(self) -> str:
+        return f"{self.kind}@{self.step}"
+
+
+def current_attempt() -> int:
+    """This process's restart-attempt index (0 = first launch), exported by
+    ``launch.run_with_restarts`` so faults can be scoped per attempt."""
+    try:
+        return int(os.environ.get(ENV_ATTEMPT, "0"))
+    except ValueError:
+        return 0
+
+
+def parse_plan(text: str) -> tuple[Fault, ...]:
+    """Parse the ``kind@step[:qualifier...]`` grammar. Raises ValueError on
+    anything it does not understand — a fault plan that silently parses to
+    nothing would fake chaos coverage."""
+    faults = []
+    for raw in text.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        kind, sep, rest = entry.partition("@")
+        kind = kind.strip()
+        if not sep:
+            raise ValueError(
+                f"bad fault entry {entry!r}: expected kind@step[:qualifier"
+                f"...] (e.g. sigkill@6, loader_stall@3:2.5s, crash@4:a1)")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {entry!r}; expected one of "
+                f"{sorted(KINDS)}")
+        bits = rest.split(":")
+        try:
+            step = int(bits[0])
+        except ValueError:
+            raise ValueError(
+                f"bad fault step in {entry!r}: {bits[0]!r} is not an "
+                f"integer") from None
+        if step <= 0:
+            raise ValueError(f"fault step must be positive in {entry!r}")
+        attempt, seconds = 0, 5.0
+        for q in bits[1:]:
+            q = q.strip()
+            if q == "always":
+                attempt = ALWAYS
+            elif len(q) > 1 and q[0] == "a" and q[1:].isdigit():
+                attempt = int(q[1:])
+            elif q.endswith("s"):
+                try:
+                    seconds = float(q[:-1])
+                except ValueError:
+                    raise ValueError(
+                        f"bad stall duration {q!r} in {entry!r}") from None
+                if seconds < 0:
+                    raise ValueError(
+                        f"stall duration must be >= 0 in {entry!r}")
+            else:
+                raise ValueError(
+                    f"unknown fault qualifier {q!r} in {entry!r} (expected "
+                    f"aN, always, or <seconds>s)")
+        faults.append(Fault(kind, step, attempt, seconds))
+    return tuple(faults)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """The faults live for THIS process on THIS restart attempt."""
+
+    faults: tuple[Fault, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def boundary_steps(self) -> tuple[int, ...]:
+        """Steps where the loop must take host-side action (block splits for
+        the fused runner): every step-scoped fault."""
+        return tuple(sorted({f.step for f in self.faults}))
+
+    def nan_grad_steps(self) -> tuple[int, ...]:
+        """``state.step`` values at which the compiled step poisons grads:
+        ``nan_grads@N`` hits the update advancing step N-1 -> N."""
+        return tuple(sorted(
+            {f.step - 1 for f in self.faults if f.kind == "nan_grads"}))
+
+    def loader_stalls(self) -> dict[int, float]:
+        """step -> stall seconds for the host-streaming data source."""
+        return {f.step: f.seconds for f in self.faults
+                if f.kind == "loader_stall"}
+
+    def process_faults_at(self, step: int) -> tuple[Fault, ...]:
+        """Process-level faults at ``step``, in plan order — order matters
+        (corrupt-then-kill is not kill-then-corrupt)."""
+        return tuple(f for f in self.faults
+                     if f.step == step and f.kind in _PROCESS_KINDS)
+
+    @property
+    def has_process_faults(self) -> bool:
+        return any(f.kind in _PROCESS_KINDS for f in self.faults)
+
+    def validate(self, total_steps: int, *,
+                 checkpoint_dir: Optional[str] = None) -> None:
+        for f in self.faults:
+            if f.step > total_steps:
+                raise ValueError(
+                    f"{f.describe()} is beyond total_steps={total_steps}; "
+                    f"the injected fault would never fire")
+            if f.kind == "corrupt_latest_ckpt" and not checkpoint_dir:
+                raise ValueError(
+                    f"{f.describe()} needs a checkpoint_dir — there is no "
+                    f"checkpoint to corrupt")
+
+
+def resolve(config=None) -> FaultPlan:
+    """The effective plan for this process: ``config.fault_plan`` +
+    ``DDL_FAULT_PLAN`` (per-child injection) + the legacy ``fail_at_step``
+    shim, filtered down to the current restart attempt. With no plan
+    configured this returns an empty (falsy) plan and every injection site
+    compiles/installs nothing."""
+    parts: list[Fault] = []
+    if config is not None:
+        text = getattr(config, "fault_plan", None)
+        if text:
+            parts.extend(parse_plan(text))
+        fail_at = getattr(config, "fail_at_step", None)
+        if fail_at is not None:
+            # Deprecation shim: the single-fault flag is exactly crash@N,
+            # with ALWAYS semantics (the flag re-fired on resumed runs that
+            # passed it again — attempt scoping arrived with plans).
+            parts.append(Fault("crash", int(fail_at), attempt=ALWAYS))
+    env_text = os.environ.get(ENV_PLAN)
+    if env_text:
+        parts.extend(parse_plan(env_text))
+    attempt = current_attempt()
+    return FaultPlan(tuple(
+        f for f in parts if f.attempt in (ALWAYS, attempt)))
+
+
+def stream_guard_kwargs(config, *, train: bool = True) -> dict:
+    """Watchdog + stall-injection kwargs for StreamSource, derived from the
+    config (DataConfig.loader_timeout_s/loader_retries) and the resolved
+    plan. Empty dict = watchdog off, no injection — the default."""
+    kw: dict = {}
+    data = getattr(config, "data", None)
+    timeout_s = float(getattr(data, "loader_timeout_s", 0.0) or 0.0)
+    if timeout_s > 0:
+        kw["timeout_s"] = timeout_s
+        kw["max_retries"] = int(getattr(data, "loader_retries", 2))
+    if train:
+        stalls = resolve(config).loader_stalls()
+        if stalls:
+            kw["stall_steps"] = stalls
+    return kw
+
+
+# ---------------------------------------------------------------------------
+# Loop-side injector (process-level faults)
+# ---------------------------------------------------------------------------
+
+def make_injector(plan: FaultPlan, ckpt, checkpoint_dir: Optional[str]):
+    """A per-step callable firing the plan's process-level faults, or None
+    when the plan has none — the loop then executes zero fault code per
+    step (one ``is not None`` check)."""
+    if not plan.has_process_faults:
+        return None
+    steps_with_faults = {f.step for f in plan.faults
+                         if f.kind in _PROCESS_KINDS}
+
+    def fire(step: int) -> None:
+        if step not in steps_with_faults:
+            return
+        for f in plan.process_faults_at(step):
+            _fire_one(f, step, ckpt, checkpoint_dir)
+
+    return fire
+
+
+def _fire_one(fault: Fault, step: int, ckpt, checkpoint_dir) -> None:
+    import sys
+    if fault.kind == "corrupt_latest_ckpt":
+        if ckpt is not None:
+            ckpt.wait()  # damage a COMMITTED save, not an in-flight one
+        hit = corrupt_latest_checkpoint(checkpoint_dir)
+        print(f"# fault injection: corrupted checkpoint step {hit} in "
+              f"{checkpoint_dir}", file=sys.stderr, flush=True)
+    elif fault.kind == "sigterm":
+        import signal
+        print(f"# fault injection: SIGTERM to self after step {step}",
+              file=sys.stderr, flush=True)
+        # The loop's preemption handler (when installed) turns this into a
+        # forced save + clean-ish exit; without a handler the process dies
+        # with the default disposition — both are the point of the fault.
+        os.kill(os.getpid(), signal.SIGTERM)
+    elif fault.kind == "sigkill":
+        import signal
+        print(f"# fault injection: SIGKILL to self after step {step}",
+              file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.kind == "crash":
+        if ckpt is not None:
+            ckpt.wait()
+        raise SystemExit(f"fault injection: killed after step {step}")
+
+
+def corrupt_latest_checkpoint(directory: str) -> Optional[int]:
+    """Deterministically damage the newest committed checkpoint step:
+    garbage bytes over its array/metadata files, commit marker left intact
+    so the step still *claims* to be restorable — the shape of a partial or
+    bit-rotted write that the restore path must quarantine. Returns the
+    damaged step, or None when there is nothing to damage."""
+    if not directory or not os.path.isdir(directory):
+        return None
+    steps = [int(e) for e in os.listdir(directory) if e.isdigit()]
+    if not steps:
+        return None
+    step = max(steps)
+    root = os.path.join(directory, str(step))
+    hit = 0
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            if name == "_CHECKPOINT_METADATA":
+                continue  # the commit marker: the step must stay "latest"
+            try:
+                with open(os.path.join(dirpath, name), "wb") as fh:
+                    fh.write(b"\x00DDL_FAULT_CORRUPTED\x00")
+                hit += 1
+            except OSError:
+                pass
+    return step if hit else None

@@ -33,6 +33,26 @@ def _abstract_like(state: Any) -> Any:
         state)
 
 
+class _CorruptCheckpoint(Exception):
+    """A step that orbax could not read back — corrupt or partially written.
+
+    Deliberately wraps ONLY failures coming out of ``CheckpointManager
+    .restore`` itself: policy errors raised by our own checks (EMA-flip
+    rejection, structure/shape mismatches) are user-config problems and
+    must propagate, never trigger quarantine of a perfectly good save."""
+
+    def __init__(self, step: int, cause: BaseException):
+        super().__init__(f"checkpoint step {step} failed to restore: "
+                         f"{type(cause).__name__}: {cause}")
+        self.step = step
+        self.cause = cause
+
+
+# How many corrupt steps restore will quarantine before giving up — bounds
+# the cost of a directory full of damaged saves to a couple of retries.
+_MAX_QUARANTINE = 2
+
+
 class Checkpointer:
     """Thin policy wrapper over ``ocp.CheckpointManager``.
 
@@ -53,10 +73,16 @@ class Checkpointer:
                  max_to_keep: int = 3, converter: Any = None):
         self.every_steps = max(int(every_steps), 1)
         self._converter = converter
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),  # orbax rejects relative paths
+        self._directory = os.path.abspath(directory)  # orbax rejects
+        self._max_to_keep = max_to_keep               # relative paths
+        self._mgr = self._make_manager()
+
+    def _make_manager(self) -> ocp.CheckpointManager:
+        return ocp.CheckpointManager(
+            self._directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, enable_async_checkpointing=True))
+                max_to_keep=self._max_to_keep,
+                enable_async_checkpointing=True))
 
     @classmethod
     def create(cls, config: TrainConfig,
@@ -83,9 +109,84 @@ class Checkpointer:
             state = self._converter.to_canonical(state)
         return self._mgr.save(step, args=ocp.args.StandardSave(state))
 
+    # --- corrupt-step quarantine + fallback --------------------------------
+
+    def _mgr_restore(self, step: int, args: Any) -> Any:
+        """The ONE call site allowed to classify a failure as corruption:
+        anything ``CheckpointManager.restore`` raises for a committed step
+        means that step's bytes are unusable."""
+        try:
+            return self._mgr.restore(step, args=args)
+        except Exception as e:
+            raise _CorruptCheckpoint(step, e) from e
+
+    def _with_fallback(self, restore_fn) -> Optional[Any]:
+        """Run ``restore_fn(latest_step)``; on corruption, quarantine the
+        step and retry the next-newest, up to ``_MAX_QUARANTINE`` times.
+        Never silently falls through to a fresh start: a directory whose
+        every checkpoint is damaged raises instead of discarding the run's
+        history."""
+        quarantined = 0
+        while True:
+            step = self._mgr.latest_step()
+            if step is None:
+                if quarantined:
+                    raise RuntimeError(
+                        f"no restorable checkpoint left in "
+                        f"{self._directory} after quarantining "
+                        f"{quarantined} corrupt step(s) (kept as corrupt.* "
+                        f"for post-mortem); refusing to silently restart "
+                        f"from scratch — delete the directory to do that "
+                        f"deliberately")
+                return None
+            try:
+                return restore_fn(step)
+            except _CorruptCheckpoint as e:
+                if quarantined >= _MAX_QUARANTINE:
+                    raise e.cause
+                self._quarantine(step, e.cause)
+                quarantined += 1
+
+    def _quarantine(self, step: int, err: BaseException) -> None:
+        """Move a corrupt step dir aside (``corrupt.<step>`` — non-numeric,
+        so orbax's latest_step never sees it again) with a loud warning."""
+        import warnings
+
+        src = os.path.join(self._directory, str(step))
+        dst = os.path.join(self._directory, f"corrupt.{step}")
+        warnings.warn(
+            f"checkpoint step {step} failed to restore "
+            f"({type(err).__name__}: {err}); quarantining it as {dst} and "
+            f"falling back to the previous good checkpoint. This usually "
+            f"means the save was cut short (preemption/disk) — inspect the "
+            f"quarantined directory if it recurs.")
+        if jax.process_index() == 0 and os.path.isdir(src):
+            while os.path.exists(dst):
+                dst += ".x"
+            os.rename(src, dst)
+        if jax.process_count() > 1:
+            # Every process must see the rename before re-asking for
+            # latest_step, or a fast process retries the same corrupt step.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ddl:quarantine:{step}")
+        self._reload()
+
+    def _reload(self) -> None:
+        """Refresh the manager's view of the directory after a quarantine
+        rename (step caches vary by orbax version; recreate if needed)."""
+        reload_fn = getattr(self._mgr, "reload", None)
+        if callable(reload_fn):
+            reload_fn()
+            return
+        self._mgr.close()
+        self._mgr = self._make_manager()
+
     def restore_latest(self, state_like: Any) -> Optional[Any]:
         """Restore the newest checkpoint into ``state_like``'s layout, or
-        None when the directory is empty (fresh run).
+        None when the directory is empty (fresh run). A corrupt/partial
+        newest step is quarantined (loud warning, dir renamed corrupt.N)
+        and the previous good step restored instead.
 
         ``ema_params`` presence may legitimately differ from the checkpoint:
         ``--ema-decay`` can be turned on mid-experiment (resume a pre-EMA
@@ -95,9 +196,10 @@ class Checkpointer:
         loudly: silently discarding trained state contradicts the repo's
         dead-knob policy, and before this check it surfaced as an opaque
         orbax structure-mismatch error (ADVICE r3 #2)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
+        return self._with_fallback(
+            lambda step: self._restore_latest_at(step, state_like))
+
+    def _restore_latest_at(self, step: int, state_like: Any) -> Any:
         if self._converter is not None:
             # Restore targets the canonical on-disk layout (replicated),
             # then reshard-on-restore pads + scatters the optimizer state
@@ -120,12 +222,12 @@ class Checkpointer:
                 f"checkpoint step {step} predates --ema-decay: seeding the "
                 f"EMA shadow from the restored params (the same way a fresh "
                 f"run seeds it from init).")
-            restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
+            restored = self._mgr_restore(step, ocp.args.StandardRestore(
                 _abstract_like(state_like.replace(ema_params=None))))
             restored = restored.replace(ema_params=restored.params)
             return self._from_canonical(restored)
-        return self._from_canonical(self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_abstract_like(state_like))))
+        return self._from_canonical(self._mgr_restore(
+            step, ocp.args.StandardRestore(_abstract_like(state_like))))
 
     def _from_canonical(self, restored: Any) -> Any:
         if self._converter is None:
@@ -219,30 +321,28 @@ class Checkpointer:
         sampler neither knows nor needs. Uses a raw (target-less) restore —
         this orbax version has no partial StandardRestore — so the whole
         tree loads to host once; sampler-scale only."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        restored = self._restore_raw(step)
-        return self._restore_subtree(restored["params"], params_like,
-                                     "params")
+        return self._with_fallback(
+            lambda step: self._restore_subtree(
+                self._restore_raw(step)["params"], params_like, "params"))
 
     def _restore_raw(self, step: int) -> Any:
         """Target-less restore of the raw checkpoint tree (host arrays).
         This orbax version's ``restore(step)`` with no args needs a handler
         registry to reconstruct the item; the explicit empty
         ``StandardRestore`` asks for the tree as saved instead."""
-        return self._mgr.restore(step, args=ocp.args.StandardRestore())
+        return self._mgr_restore(step, ocp.args.StandardRestore())
 
     def restore_latest_for_eval(self, state_like: Any) -> Optional[Any]:
         """Restore params + BN statistics + step — everything inference
         needs — keeping ``state_like``'s (fresh) optimizer state, so
         eval-only runs don't have to repeat the training run's optimizer
         flags to satisfy a StandardRestore structure match."""
+        return self._with_fallback(
+            lambda step: self._restore_for_eval_at(step, state_like))
+
+    def _restore_for_eval_at(self, step: int, state_like: Any) -> Any:
         import jax.numpy as jnp
 
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
         restored = self._restore_raw(step)
         params = self._restore_subtree(restored["params"], state_like.params,
                                        "params")

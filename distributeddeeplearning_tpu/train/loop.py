@@ -26,6 +26,7 @@ from distributeddeeplearning_tpu.models import model_spec
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero as zerolib
+from distributeddeeplearning_tpu.robustness import faults as faultslib
 from distributeddeeplearning_tpu.train import checkpoint as ckptlib
 from distributeddeeplearning_tpu.train import optim, steps
 from distributeddeeplearning_tpu.train import state as statelib
@@ -302,10 +303,12 @@ def run(config: TrainConfig, *, total_steps: int,
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
                return_state, restore_for_eval=False) -> dict[str, Any]:
-    if config.fail_at_step is not None and config.fail_at_step > total_steps:
-        raise ValueError(
-            f"fail_at_step={config.fail_at_step} is beyond "
-            f"total_steps={total_steps}; the injected fault would never fire")
+    # Fault plan (robustness/faults.py): config.fault_plan + the per-child
+    # DDL_FAULT_PLAN env + the legacy fail_at_step shim, filtered to this
+    # restart attempt. Empty plan (the default) => injector is None and the
+    # hot loop runs zero fault-injection code.
+    fault_plan = faultslib.resolve(config)
+    fault_plan.validate(total_steps, checkpoint_dir=config.checkpoint_dir)
     start_step = 0
     resolved_loader = datalib.resolve_loader(config, spec.input_kind)
     if ckpt is not None:
@@ -399,7 +402,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         for c in cadences:
             if c > 0:
                 cands.append((pos // c + 1) * c)
-        points = [start_step + warmup_steps, config.fail_at_step]
+        points = [start_step + warmup_steps, *fault_plan.boundary_steps()]
         if config.profile_steps is not None:
             points.extend(config.profile_steps)
         cands.extend(a for a in points if a is not None and a > pos)
@@ -423,6 +426,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             preempted["signum"] = signum
         prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
 
+    injector = faultslib.make_injector(fault_plan, ckpt,
+                                       config.checkpoint_dir)
+    bad_tracker = _BadStepTracker(config.bad_step_limit)
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
@@ -446,6 +452,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 state, metrics = fused_runner(state, rng, i, n)
             i += n
             profile.after_step(i - 1, metrics)
+            bad_tracker.push(metrics)
             done = i - start_step
             if done == warmup_steps:
                 # device_get, not block_until_ready: a fetch is a true
@@ -476,20 +483,20 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     # Keep throughput numbers about training: shift the
                     # timing origin past the eval pause.
                     t_timed += time.perf_counter() - t_eval
-            if config.fail_at_step is not None and i == config.fail_at_step:
-                # Fault injection (SURVEY.md §5.3): die like a preempted host
-                # so the launcher's fail-whole path + checkpoint-resume get
-                # exercised end-to-end.
-                if ckpt is not None:
-                    ckpt.wait()
-                raise SystemExit(
-                    f"fault injection: killed after step {i}")
+            if injector is not None:
+                # Scheduled fault injection (SURVEY.md §5.3, robustness/
+                # faults.py): crash/sigterm/sigkill/corrupt after completing
+                # step i — AFTER maybe_save, so a cadence save at i is
+                # already (async-)launched when the fault lands, exactly the
+                # race a real preemption exposes.
+                injector(i)
         # End-of-run sync: fetching the final step's metrics and step counter
         # is a true completion barrier for the whole dispatch queue (the last
         # program's outputs exist only after it ran), without a per-leaf
         # readiness walk over the params/opt-state tree — which on a
         # remote-tunneled device costs seconds and would pollute timing.
         jax.device_get((metrics, state.step))
+        bad_tracker.drain()
     finally:
         # prev may be None when the prior handler was installed from C (not
         # visible to Python) — restoring None would raise inside finally and
@@ -508,6 +515,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         "final_step": end_step,
         "start_step": start_step,
         "final_metrics": {k: float(v) for k, v in metrics.items()},
+        "bad_steps": bad_tracker.total,
     }
     hbm = _device_memory_stats(state)
     if hbm:
@@ -547,6 +555,55 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if return_state:
         summary["state"] = state
     return summary
+
+
+class _BadStepTracker:
+    """Host-side circuit breaker over the compiled step's ``bad_step`` flag.
+
+    The guard in train/steps.py skips non-finite updates on-device; this
+    tracker counts the skips and aborts the run after ``limit`` CONSECUTIVE
+    skips (a run whose every step is bad is diverged, not unlucky). Flags
+    are fetched LAGGED — a flag is only ``float()``-ed once two newer steps
+    have been dispatched, by which time its program has executed — so the
+    breaker never synchronizes the async dispatch pipeline; the remainder
+    drains at end of run. Fused multi-step blocks report their last step's
+    flag only, so under ``steps_per_loop`` the count is per-block (blocks
+    split at injected-fault boundaries, keeping chaos tests exact).
+    """
+
+    _LAG = 2
+
+    def __init__(self, limit: int):
+        self.limit = max(int(limit), 1)
+        self.total = 0
+        self._consecutive = 0
+        self._window: list = []
+
+    def push(self, metrics) -> None:
+        flag = metrics.get("bad_step")
+        if flag is None:
+            return
+        self._window.append(flag)
+        if len(self._window) > self._LAG:
+            self._check(self._window.pop(0))
+
+    def drain(self) -> None:
+        while self._window:
+            self._check(self._window.pop(0))
+
+    def _check(self, flag) -> None:
+        if float(jax.device_get(flag)) > 0:
+            self.total += 1
+            self._consecutive += 1
+            if self._consecutive >= self.limit:
+                raise RuntimeError(
+                    f"aborting: {self._consecutive} consecutive non-finite "
+                    f"update steps (bad_step_limit={self.limit}) — the run "
+                    f"is diverging, not hitting stray bad batches; lower "
+                    f"the learning rate or inspect the data shards. "
+                    f"{self.total} update(s) were skipped in total.")
+        else:
+            self._consecutive = 0
 
 
 def _device_memory_stats(state=None) -> Optional[dict]:

@@ -39,10 +39,54 @@ from distributeddeeplearning_tpu.parallel import collectives
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero
 from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+from distributeddeeplearning_tpu.robustness import faults
 from distributeddeeplearning_tpu.train import losses
 from distributeddeeplearning_tpu.train.state import TrainState
 
 DATA_AXES = ("data", "fsdp")
+
+
+def _inject_nan_grads(grads, step, nan_steps):
+    """Fault injection (robustness/faults.py): poison the gradients of the
+    updates whose pre-update ``state.step`` is in ``nan_steps``. Compiled in
+    ONLY when a fault plan asks for it — the plan-free hot path carries no
+    injection ops."""
+    hit = jnp.zeros((), jnp.bool_)
+    for s in nan_steps:
+        hit = jnp.logical_or(hit, step == jnp.int32(s))
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(hit, jnp.full_like(g, jnp.nan), g), grads)
+
+
+def _tree_sq_norm(tree):
+    """Squared norm of a tree in f32 (finite iff every leaf is; values big
+    enough to overflow the f32 sum also flag — such a step is equally
+    unusable)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+
+def _skip_if_bad(bad, new_tree, old_tree):
+    """Bad-step guard: keep the pre-update value on every leaf when ``bad``.
+    The select passes the already-computed new values through unchanged on
+    good steps, so good-step numerics are value-identical."""
+    if new_tree is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(bad, o, n), new_tree, old_tree)
+
+
+def _guard_config(config: TrainConfig):
+    """(nan_steps, guard_on) for this build. The guard is compiled in only
+    when asked for — explicitly (``bad_step_guard``) or implicitly by a plan
+    that injects NaN gradients. It cannot be unconditionally on: keeping the
+    pre-update state alive for the skip-select blocks the donated buffers'
+    in-place reuse, which re-fuses the surrounding XLA program and drifts
+    the trajectory ~1 ULP — breaking the zero1<->replicated bitwise pin
+    (tests/test_zero1.py). Guard-free builds compile the exact seed program."""
+    nan_steps = faults.resolve(config).nan_grad_steps()
+    guard = bool(nan_steps) or bool(getattr(config, "bad_step_guard", False))
+    return nan_steps, guard
 
 
 def _ema_update(ema, new_params, decay: float):
@@ -222,6 +266,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
     accum = config.grad_accum_steps
 
+    nan_steps, guard = _guard_config(config)
     zero1 = getattr(config, "optimizer_sharding", "none") == "zero1"
     layout = payload = None
     if zero1:
@@ -247,6 +292,9 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         grads, new_bn, metrics = accumulated_grads(
             loss_fn, state.params, state.batch_stats, batch, rng, accum,
             vary_axes=DATA_AXES)
+
+        if nan_steps:
+            grads = _inject_nan_grads(grads, state.step, nan_steps)
 
         metrics = jax.lax.pmean(metrics, DATA_AXES)
         if new_bn is not None:
@@ -289,6 +337,25 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         new_ema = _ema_update(state.ema_params, new_params,
                               config.optimizer.ema_decay)
+        if guard:
+            # Bad-step guard (docs/fault_tolerance.md). The decision must be
+            # identical on every shard, so derive it ONLY from values that
+            # already are: the pmean'd loss and the post-update params
+            # (post-all-reduce here, post-all-gather under zero1).
+            # Non-finite grads on ANY shard propagate through the reduction
+            # and the optimizer into the params, so checking the result
+            # catches them — one local (collective-free) reduction per step.
+            bad = jnp.logical_or(~jnp.isfinite(metrics["loss"]),
+                                 ~jnp.isfinite(_tree_sq_norm(new_params)))
+            # Skip-on-bad: the step index still advances (the batch is
+            # consumed; a skip is a skip, not a retry), but params/opt/BN/
+            # EMA keep their pre-update values so one poisoned batch can't
+            # wreck the run.
+            new_params = _skip_if_bad(bad, new_params, state.params)
+            new_opt = _skip_if_bad(bad, new_opt, state.opt_state)
+            new_bn = _skip_if_bad(bad, new_bn, state.batch_stats)
+            new_ema = _skip_if_bad(bad, new_ema, state.ema_params)
+            metrics["bad_step"] = bad.astype(jnp.float32)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, batch_stats=new_bn,
                                ema_params=new_ema)
@@ -435,6 +502,7 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
                           state_shardings, input_kind: str = "tokens",
                           objective: str = "mlm"):
     loss_fn = loss_fn_for(model, input_kind, config, objective)
+    nan_steps, bad_guard = _guard_config(config)
     # Token batches are (B, S): dim 0 over the DP axes, dim 1 over `seq`.
     seq_dim = 1 if input_kind == "tokens" else None
     batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
@@ -449,10 +517,24 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
             grads, new_bn, metrics = accumulated_grads(
                 loss_fn, state.params, state.batch_stats, batch, rng,
                 config.grad_accum_steps)
+        if nan_steps:
+            grads = _inject_nan_grads(grads, state.step, nan_steps)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_ema = _ema_update(state.ema_params, new_params,
                               config.optimizer.ema_decay)
+        if bad_guard:
+            # Bad-step guard on the post-update params (same placement as
+            # the DP path). One logical program: XLA inserts any cross-shard
+            # reduction the norm needs, so the scalar is globally
+            # consistent without an explicit psum.
+            bad = jnp.logical_or(~jnp.isfinite(metrics["loss"]),
+                                 ~jnp.isfinite(_tree_sq_norm(new_params)))
+            new_params = _skip_if_bad(bad, new_params, state.params)
+            new_opt = _skip_if_bad(bad, new_opt, state.opt_state)
+            new_bn = _skip_if_bad(bad, new_bn, state.batch_stats)
+            new_ema = _skip_if_bad(bad, new_ema, state.ema_params)
+            metrics["bad_step"] = bad.astype(jnp.float32)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, batch_stats=new_bn,
                                ema_params=new_ema)

@@ -233,4 +233,16 @@ check_stop flash
 timeout 600 python tools/validate_flash_tpu.py \
   > "$RES/flash_validate.json" 2>> "$RES/log.txt"
 note flash
+
+# 12. Chaos recovery overhead (gated, OFF by default: it runs on CPU and
+# needs no chip, so it must never spend window time unless explicitly
+# asked for with DDL_CHAOS=1 — e.g. a window opened purely to refresh the
+# robustness numbers). Measures time-to-resume after an injected crash
+# under launch.py --max-restarts (docs/fault_tolerance.md).
+if [ "${DDL_CHAOS:-0}" = "1" ]; then
+  check_stop chaos
+  timeout 600 env JAX_PLATFORMS=cpu python bench.py --chaos \
+    > "$RES/chaos_recovery.json" 2>> "$RES/log.txt"
+  note chaos
+fi
 echo "[$(stamp)] window done" >> "$RES/log.txt"

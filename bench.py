@@ -146,6 +146,12 @@ def _metric_name_unit(args) -> tuple[str, str]:
     # evict the replicated headline's last-good entry.
     if getattr(args, "optimizer_sharding", None) == "zero1":
         perleaf += "_zero1"
+    # Tracing adds per-step clock reads inside the timed window — protocol
+    # drift by design (it's how the overhead A/B measures itself), so traced
+    # numbers live under their own metric name and can never evict an
+    # untraced last-good entry.
+    if getattr(args, "trace_dir", None):
+        perleaf += "_tele"
     if objective:
         gather = f"_g{mp}" if mp > 0 else ""
         return (f"{args.model}{perleaf}_{objective}_s{args.seq_len}{gather}"
@@ -179,6 +185,8 @@ def _protocol_suffix(args) -> str:
         parts.append("ar-bf16")
     if getattr(args, "optimizer_sharding", None) == "zero1":
         parts.append("zero1")
+    if getattr(args, "trace_dir", None):
+        parts.append("tele")
     return (" " + "+".join(parts)) if parts else ""
 
 
@@ -272,7 +280,16 @@ def _child_measure(args, emit_quick: bool = True,
         AllReduceConfig, DataConfig, ParallelConfig, TrainConfig,
         resolve_mlm_max_predictions)
     from distributeddeeplearning_tpu.models import model_spec
+    from distributeddeeplearning_tpu.observability import telemetry
     from distributeddeeplearning_tpu.train import loop
+
+    # Configure telemetry BEFORE build: the per-bucket collective spans are
+    # recorded at trace time, i.e. during the first train_step compile.
+    tele = None
+    if getattr(args, "trace_dir", None):
+        tele = telemetry.configure(trace_dir=args.trace_dir,
+                                   process_index=jax.process_index(),
+                                   process_name="bench")
 
     n_dev = jax.device_count()
     spec = model_spec(args.model)
@@ -353,13 +370,35 @@ def _child_measure(args, emit_quick: bool = True,
         chunk = n_steps if deadline is None else 5
         while done < n_steps:
             for _ in range(min(chunk, n_steps - done)):
-                state, metrics = train_step(state, source.batch(i), rng)
+                if tele is None:
+                    state, metrics = train_step(state, source.batch(i), rng)
+                else:
+                    # Traced protocol (metric name carries _tele): two extra
+                    # monotonic reads per step split data_wait from dispatch.
+                    ta = telemetry.now_s()
+                    batch = source.batch(i)
+                    tb = telemetry.now_s()
+                    state, metrics = train_step(state, batch, rng)
+                    tc = telemetry.now_s()
+                    tele.record_span("data_wait", ta, tb, step=i)
+                    tele.record_span("dispatch", tb, tc, step=i)
                 i += 1
                 done += 1
-            jax.device_get(metrics)
+            if tele is None:
+                jax.device_get(metrics)
+            else:
+                with tele.span("fetch_barrier", step=i - 1):
+                    jax.device_get(metrics)
             if deadline is not None and time.monotonic() >= deadline:
                 break
         return done, time.perf_counter() - t0
+
+    def row_extra() -> dict:
+        """Per-line annotations: memory, plus (traced rows) the phase
+        breakdown aggregated from the buffered spans so far."""
+        if tele is None:
+            return mem
+        return {**mem, "phases": telemetry.phase_totals(tele.snapshot())}
 
     # Protocol marker: chunked barriers are measurement-protocol drift vs
     # the barrier-free round-2/3 windows (one pipeline drain per 5 steps
@@ -372,7 +411,7 @@ def _child_measure(args, emit_quick: bool = True,
     if emit_quick and q_done:
         _emit_metric(args, q_rate,
                      protocol=f"quick w{quick_w}+{q_done} "
-                              f"b{args.batch_size}{mark}", extra=mem)
+                              f"b{args.batch_size}{mark}", extra=row_extra())
     # Full-protocol window: everything so far (quick_w + quick_n >= the
     # classic 10) counts as warmup; time a fresh window of args.steps.
     if deadline is None or time.monotonic() < deadline:
@@ -386,7 +425,10 @@ def _child_measure(args, emit_quick: bool = True,
             _emit_metric(args, rate,
                          protocol=f"w{quick_w + q_done}+{done} "
                                   f"b{args.batch_size}{mark}{cut}",
-                         extra=mem)
+                         extra=row_extra())
+        if tele is not None and tele.export():
+            _note(f"telemetry trace written to "
+                  f"{telemetry.trace_path(args.trace_dir, tele.process_index)}")
         return rate
     if q_done:
         # Deadline landed inside the quick window: the quick measurement
@@ -395,7 +437,10 @@ def _child_measure(args, emit_quick: bool = True,
             _emit_metric(args, q_rate,
                          protocol=f"quick w{quick_w}+{q_done} "
                                   f"b{args.batch_size}{mark} cut",
-                         extra=mem)
+                         extra=row_extra())
+        if tele is not None and tele.export():
+            _note(f"telemetry trace written to "
+                  f"{telemetry.trace_path(args.trace_dir, tele.process_index)}")
         return q_rate
     raise TimeoutError(
         f"row deadline passed before any timed step (warmup {quick_w})")
@@ -850,6 +895,14 @@ def main(argv=None) -> int:
                         "budget (measure every row to completion)")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu) for smoke runs")
+    p.add_argument("--trace-dir", default=None,
+                   help="write a Chrome-trace JSON (phase spans + per-bucket "
+                        "collective spans) for the timed windows under this "
+                        "directory, and attach a per-phase breakdown to the "
+                        "metric record; traced rows report under a _tele "
+                        "metric name because tracing reads the clock inside "
+                        "the timed loop (protocol drift by design — it is "
+                        "how the overhead A/B measures itself)")
     p.add_argument("--attempt-timeout", type=int, default=480,
                    help="hard wall-clock limit per measurement attempt (s); "
                         "the quick line lands ~1 min after backend init on "
@@ -912,14 +965,28 @@ def main(argv=None) -> int:
         if args.suite_models:
             p.error("--suite-rows and --suite-models are mutually "
                     "exclusive (rows select exact entries)")
-        names = {n for n, _m, _o, _e in SUITE}
+        row_names = [n for n, _m, _o, _e in SUITE]
         asked = [s.strip() for s in args.suite_rows.split(",") if s.strip()]
-        unknown = [s for s in asked if s not in names]
+        resolved, unknown = [], []
+        for s in asked:
+            if s in row_names:
+                resolved.append(s)
+            elif s.isdigit() and int(s) < len(row_names):
+                # Deprecated alias: positional indices predate named rows
+                # and silently select the wrong row when the suite is
+                # reordered — accept them for old drivers, but say so.
+                print(f"# bench: --suite-rows index {s} is deprecated, "
+                      f"resolving to row {row_names[int(s)]!r}; indices "
+                      f"break when suite rows are inserted or reordered",
+                      file=sys.stderr, flush=True)
+                resolved.append(row_names[int(s)])
+            else:
+                unknown.append(s)
         if not asked or unknown:
             p.error(f"--suite-rows: unknown row name(s) "
                     f"{unknown or args.suite_rows!r}; suite rows: "
-                    f"{[n for n, _m, _o, _e in SUITE]}")
-        args.suite_rows = ",".join(dict.fromkeys(asked))  # dedupe, keep order
+                    f"{row_names}")
+        args.suite_rows = ",".join(dict.fromkeys(resolved))  # dedupe, ordered
 
     if args.run_child:
         return _child(args)
@@ -953,6 +1020,8 @@ def main(argv=None) -> int:
         child_cmd += ["--allreduce-dtype", args.allreduce_dtype]
     if args.optimizer_sharding:
         child_cmd += ["--optimizer-sharding", args.optimizer_sharding]
+    if args.trace_dir:
+        child_cmd += ["--trace-dir", args.trace_dir]
     if args.suite:
         child_cmd += ["--suite"]
         if args.suite_models:

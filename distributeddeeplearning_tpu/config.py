@@ -166,6 +166,21 @@ class TrainConfig:
     resume: bool = True
     profile_steps: Optional[tuple[int, int]] = None  # SURVEY.md §5.1
     profile_dir: Optional[str] = None  # trace output (TensorBoard-loadable)
+    trace_dir: Optional[str] = None  # always-on phase telemetry
+                                  # (observability/telemetry.py): per-step
+                                  # phase spans + per-bucket collective
+                                  # spans + fault/restart instants exported
+                                  # as Chrome-trace JSON here. None = the
+                                  # no-op disabled path
+    trace_steps: Optional[tuple[int, int]] = None  # restrict step-tagged
+                                  # telemetry events to [a, b); None = the
+                                  # whole run (the ring buffer bounds
+                                  # memory either way)
+    trace_max_events: int = 200_000  # telemetry ring-buffer capacity
+    straggler_threshold: float = 1.5  # multi-host only: warn when a host's
+                                  # log-cadence step_time exceeds this x the
+                                  # cross-host mean (observability/
+                                  # straggler.py); 0 disables the allgather
     fail_at_step: Optional[int] = None  # DEPRECATED single-fault injection:
                                   # shimmed to fault_plan "crash@N:always"
                                   # (robustness/faults.py); kept so existing

@@ -39,6 +39,7 @@ import sys
 import time
 from typing import Callable, Optional, Sequence
 
+from distributeddeeplearning_tpu.observability import health, telemetry
 from distributeddeeplearning_tpu.robustness import faults
 
 ENV_COORDINATOR = "DDL_COORDINATOR"
@@ -133,15 +134,38 @@ def spawn(spec: ProcessSpec, command: Sequence[str], *,
 
 def monitor(children: Sequence[subprocess.Popen], *,
             poll_interval_s: float = 0.2,
-            grace_s: float = 10.0) -> int:
+            grace_s: float = 10.0,
+            heartbeat_dir: Optional[str] = None,
+            heartbeat_timeout_s: float = 0.0,
+            tele: Optional[telemetry.Telemetry] = None) -> int:
     """Wait for all children; kill the survivors as soon as one fails.
 
     Returns 0 iff every child exited 0 — the contract a restart wrapper
     checks before deciding to relaunch from the last checkpoint.
+
+    ``heartbeat_dir`` + ``heartbeat_timeout_s > 0`` arm the hang watchdog
+    (observability/health.py): a child whose heartbeat file stops aging for
+    longer than the timeout is presumed hung (deadlocked collective, wedged
+    loader) and SIGKILLed — the next poll then attributes it and tears the
+    job down fail-whole, exactly like a crash. A child that never beat is
+    never judged, so startup/compile time needs no grace tuning.
     """
     procs = list(children)
+    hb_armed = heartbeat_dir is not None and heartbeat_timeout_s > 0
     try:
         while True:
+            if hb_armed:
+                for idx, age in health.check_stale(
+                        heartbeat_dir, len(procs), heartbeat_timeout_s):
+                    if idx < len(procs) and procs[idx].poll() is None:
+                        print(f"# launcher: child {idx} heartbeat stale "
+                              f"({age:.1f}s > {heartbeat_timeout_s:.1f}s) — "
+                              f"presumed hung, killing (fail-whole)",
+                              file=sys.stderr, flush=True)
+                        if tele is not None:
+                            tele.instant("launcher:heartbeat_stale",
+                                         child=idx, age_s=round(age, 1))
+                        procs[idx].kill()
             codes = [p.poll() for p in procs]
             failed = [(i, c) for i, c in enumerate(codes)
                       if c not in (None, 0)]
@@ -183,16 +207,35 @@ def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float) -> None:
 
 def run_local(num_processes: int, command: Sequence[str], *,
               port: int = 9531,
-              child_env: Optional[dict[int, dict[str, str]]] = None) -> int:
+              child_env: Optional[dict[int, dict[str, str]]] = None,
+              heartbeat_dir: Optional[str] = None,
+              heartbeat_timeout_s: float = 0.0,
+              tele: Optional[telemetry.Telemetry] = None) -> int:
     """Spawn + monitor N local processes (the `mpirun -np N` replacement).
 
     ``child_env`` maps process_id → extra env vars for that child only —
     how ``--child-fault-plan`` targets one rank of a simulated pod.
+    With a ``heartbeat_dir``, children are told to beat there
+    (``DDL_HEARTBEAT_DIR``; the train loop beats on log cadence) and the
+    monitor watches for staleness.
     """
     specs = plan_local(num_processes, port=port)
-    children = [spawn(s, command, extra_env=(child_env or {}).get(
-        s.process_id)) for s in specs]
-    return monitor(children)
+    if heartbeat_dir is not None:
+        # A restarted attempt must not be judged by the previous attempt's
+        # (now frozen) heartbeats: each attempt re-arms from nothing.
+        for s in specs:
+            try:
+                os.remove(health.heartbeat_path(heartbeat_dir, s.process_id))
+            except OSError:
+                pass
+    children = []
+    for s in specs:
+        extra = dict((child_env or {}).get(s.process_id) or {})
+        if heartbeat_dir is not None:
+            extra[health.ENV_HEARTBEAT_DIR] = heartbeat_dir
+        children.append(spawn(s, command, extra_env=extra))
+    return monitor(children, heartbeat_dir=heartbeat_dir,
+                   heartbeat_timeout_s=heartbeat_timeout_s, tele=tele)
 
 
 def _backoff_delay(attempt: int, base_s: float, cap_s: float) -> float:
@@ -221,7 +264,8 @@ def run_with_restarts(run_once, max_restarts: int, *,
                       backoff_s: float = 3.0,
                       backoff_cap_s: float = 60.0,
                       progress_fn: Optional[Callable[[], object]] = None,
-                      sleep=None) -> int:
+                      sleep=None,
+                      tele: Optional[telemetry.Telemetry] = None) -> int:
     """Fail-whole + auto-relaunch: the in-launcher restart wrapper.
 
     The reference's failure story was "mpirun dies whole, Batch AI resubmits
@@ -261,6 +305,9 @@ def run_with_restarts(run_once, max_restarts: int, *,
             total += 1
             if rc == 0:
                 return rc
+            if tele is not None:
+                tele.instant("launcher:attempt_failed", rc=rc,
+                             attempt=total - 1)
             if rc in _OPERATOR_STOP_RCS:
                 print(f"# launcher: operator stop (rc={rc}); not retrying",
                       file=sys.stderr, flush=True)
@@ -283,6 +330,9 @@ def run_with_restarts(run_once, max_restarts: int, *,
                 return rc
             window_used += 1
             delay = _backoff_delay(window_used, backoff_s, backoff_cap_s)
+            if tele is not None:
+                tele.instant("launcher:restart", attempt=total,
+                             restart=window_used, backoff_s=round(delay, 2))
             print(f"# launcher: job failed (rc={rc}); restart "
                   f"{window_used}/{max_restarts} in {delay:.1f}s "
                   f"(resumes from the latest checkpoint)",
@@ -337,6 +387,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="inject a fault plan (robustness/faults.py grammar) "
                         "into one local child, e.g. 0:sigkill@20 "
                         "(repeatable; local --num-processes jobs only)")
+    p.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                   help="kill a child whose heartbeat file "
+                        "(observability/health.py; children beat on their "
+                        "log cadence) goes stale for this many seconds — a "
+                        "hung child then feeds the normal fail-whole + "
+                        "restart machinery. 0 disables. Size it well above "
+                        "the training log interval")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="heartbeat file directory (default: a fresh temp "
+                        "dir; local --num-processes jobs only)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -352,6 +412,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             p.error("--hostfile requires --process-id")
         if args.child_fault_plan:
             p.error("--child-fault-plan only supports local "
+                    "(--num-processes) jobs")
+        if args.heartbeat_timeout:
+            # The watchdog kills by local child index; a hostfile job's one
+            # local child maps to a remote rank set this launcher cannot
+            # attribute — keep the semantics local-only, like restarts.
+            p.error("--heartbeat-timeout only supports local "
                     "(--num-processes) jobs")
         if args.max_restarts:
             # A per-host restart decision is wrong for a whole-job semantic:
@@ -379,21 +445,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if ckpt_dir is not None:
         progress_fn = lambda: _latest_ckpt_step(ckpt_dir)  # noqa: E731
 
-    return run_with_restarts(
-        lambda: run_local(n, command, port=args.port, child_env=child_env),
+    heartbeat_dir = None
+    if args.heartbeat_timeout > 0:
+        import tempfile
+        heartbeat_dir = args.heartbeat_dir or tempfile.mkdtemp(
+            prefix="ddl_heartbeat_")
+
+    # When the training command traces (--trace-dir), the launcher records
+    # its restart/backoff/stale-heartbeat instants too and merges them into
+    # process 0's trace AFTER the job ends — one Chrome-trace file then
+    # shows the whole chaos story (step phases + faults + restarts).
+    # Timestamps are CLOCK_MONOTONIC, shared across local processes.
+    trace_dir = _flag_from_command(command, "--trace-dir")
+    tele = None
+    if trace_dir is not None:
+        tele = telemetry.Telemetry(enabled=True, process_index=os.getpid(),
+                                   process_name="launcher")
+
+    rc = run_with_restarts(
+        lambda: run_local(n, command, port=args.port, child_env=child_env,
+                          heartbeat_dir=heartbeat_dir,
+                          heartbeat_timeout_s=args.heartbeat_timeout,
+                          tele=tele),
         args.max_restarts, backoff_s=args.backoff,
-        backoff_cap_s=args.backoff_cap, progress_fn=progress_fn)
+        backoff_cap_s=args.backoff_cap, progress_fn=progress_fn, tele=tele)
+    if tele is not None:
+        tele.export(telemetry.trace_path(trace_dir, 0))
+    return rc
+
+
+def _flag_from_command(command: Sequence[str], flag: str) -> Optional[str]:
+    """The value of ``flag`` in the training command, if present."""
+    for i, tok in enumerate(command):
+        if tok == flag and i + 1 < len(command):
+            return command[i + 1]
+        if tok.startswith(flag + "="):
+            return tok.split("=", 1)[1]
+    return None
 
 
 def _checkpoint_dir_from_command(command: Sequence[str]) -> Optional[str]:
     """The training command's --checkpoint-dir, if present — lets the
     restart budget observe progress (new checkpoint step => refill)."""
-    for i, tok in enumerate(command):
-        if tok == "--checkpoint-dir" and i + 1 < len(command):
-            return command[i + 1]
-        if tok.startswith("--checkpoint-dir="):
-            return tok.split("=", 1)[1]
-    return None
+    return _flag_from_command(command, "--checkpoint-dir")
 
 
 if __name__ == "__main__":

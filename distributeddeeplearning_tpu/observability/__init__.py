@@ -1,0 +1,16 @@
+"""Unified observability: always-on phase telemetry, heartbeat health.
+
+Three modules, split by import weight:
+
+- :mod:`.telemetry` — thread-safe span/counter/gauge registry over a
+  bounded ring buffer, exportable as Chrome-trace JSON. Pure stdlib, so
+  the jax-free launcher and the data/robustness layers import it freely.
+- :mod:`.health` — heartbeat files (child-side writer, launcher-side
+  staleness check). Pure stdlib for the same reason.
+- :mod:`.straggler` — cross-host step-time/data-wait aggregation on log
+  cadence (imports jax; the train loop is its only consumer).
+"""
+
+from distributeddeeplearning_tpu.observability import health, telemetry
+
+__all__ = ["health", "telemetry"]

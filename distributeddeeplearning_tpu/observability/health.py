@@ -1,0 +1,81 @@
+"""Heartbeat health: child-side writer, launcher-side staleness check.
+
+The launcher's fail-whole monitor (launch.py) only sees *exits* — a child
+that hangs (deadlocked collective, wedged data pipeline, remote-device
+tunnel gone quiet) keeps the whole job alive forever. Heartbeats close that
+gap: every training process touches a per-rank file on its log cadence, and
+the launcher treats a heartbeat that stops aging as a hung child, kills it,
+and lets the existing attribution + restart machinery (PR 3) take over.
+
+Pure stdlib on both sides — the launcher must never import jax.
+
+Wiring: the launcher exports ``DDL_HEARTBEAT_DIR`` to its children (plus
+the pre-existing ``DDL_PROCESS_ID``); the train loop calls
+:meth:`HeartbeatWriter.from_env` and beats on log cadence. A child that
+never writes (old binary, crashed in startup) is never judged by the
+watchdog — staleness only applies after the first beat, so startup/compile
+time needs no special-cased grace period.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+ENV_HEARTBEAT_DIR = "DDL_HEARTBEAT_DIR"
+_ENV_PROCESS_ID = "DDL_PROCESS_ID"  # set by launch.ProcessSpec.env()
+
+
+def heartbeat_path(directory: str, process_id: int) -> str:
+    return os.path.join(directory, f"heartbeat.{process_id}")
+
+
+class HeartbeatWriter:
+    """Touches this process's heartbeat file; the file's mtime IS the
+    signal (content is a small JSON breadcrumb for humans)."""
+
+    def __init__(self, directory: str, process_id: int = 0):
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.path = heartbeat_path(directory, self.process_id)
+        os.makedirs(directory, exist_ok=True)
+
+    @classmethod
+    def from_env(cls) -> Optional["HeartbeatWriter"]:
+        directory = os.environ.get(ENV_HEARTBEAT_DIR)
+        if not directory:
+            return None
+        return cls(directory, int(os.environ.get(_ENV_PROCESS_ID, "0") or 0))
+
+    def beat(self, step: int) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump({"step": int(step), "time": time.time(),
+                           "pid": os.getpid()}, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a full/broken disk must never kill the training step
+
+
+def check_stale(directory: str, num_processes: int, timeout_s: float,
+                now: Optional[float] = None) -> list[tuple[int, float]]:
+    """(process_id, age_s) for every child whose heartbeat file exists and
+    is older than ``timeout_s``. ``now`` is injectable (fake clock in
+    tests); it is compared against file mtimes, so tests steer it with
+    ``os.utime``. Children that never beat are not reported — the watchdog
+    arms per child on its first beat."""
+    if now is None:
+        now = time.time()
+    stale = []
+    for pid in range(num_processes):
+        try:
+            mtime = os.stat(heartbeat_path(directory, pid)).st_mtime
+        except OSError:
+            continue
+        age = now - mtime
+        if age > timeout_s:
+            stale.append((pid, age))
+    return stale

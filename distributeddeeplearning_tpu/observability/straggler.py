@@ -1,0 +1,80 @@
+"""Cross-host straggler detection on log cadence.
+
+A multi-host data-parallel step runs at the speed of its slowest host, but
+per-host symptoms (slow NIC, contended input volume, thermal throttling)
+are invisible in chief-only metrics — the MPI characterization work
+(PAPERS.md: arXiv:1810.11112) shows imbalance surfacing exactly as
+collective wait time. On every log-cadence step each host contributes its
+(step_time, data_wait) means since the last log via one small
+``process_allgather``; the chief logs min/max/mean skew and names the
+slowest host when it exceeds ``threshold`` x the mean.
+
+The allgather doubles as a cross-host sync point, so its cost is bounded
+by the skew it measures; single-process jobs build no monitor at all.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from distributeddeeplearning_tpu.observability import telemetry
+
+
+class StragglerMonitor:
+    """Built once per run; ``collect`` must be called by EVERY process at
+    the same steps (it is a collective)."""
+
+    def __init__(self, threshold: float, num_processes: int):
+        self.threshold = float(threshold)
+        self.num_processes = num_processes
+
+    def collect(self, step: int, step_time_s: float,
+                data_wait_s: float) -> dict:
+        """Allgather this host's phase times; returns the skew fields to
+        fold into the chief's log record (identical on every process)."""
+        import jax
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        arr = multihost_utils.process_allgather(
+            np.asarray([step_time_s, data_wait_s], np.float64))
+        arr = np.asarray(arr).reshape(self.num_processes, 2)
+        st, dw = arr[:, 0], arr[:, 1]
+        mean = float(st.mean())
+        slowest = int(st.argmax())
+        record = {
+            "host_count": self.num_processes,
+            "host_step_time_min": round(float(st.min()), 6),
+            "host_step_time_max": round(float(st.max()), 6),
+            "host_step_time_mean": round(mean, 6),
+            "host_data_wait_max": round(float(dw.max()), 6),
+        }
+        if mean > 0 and float(st.max()) > self.threshold * mean:
+            record["straggler_host"] = slowest
+            telemetry.get().instant(
+                "straggler", step=step, host=slowest,
+                step_time_s=round(float(st.max()), 6),
+                mean_s=round(mean, 6))
+            if jax.process_index() == 0:
+                print(f"# straggler: host {slowest} step_time "
+                      f"{st.max():.4f}s > {self.threshold:.2f}x mean "
+                      f"{mean:.4f}s at step {step} "
+                      f"(data_wait {dw[slowest]:.4f}s)",
+                      file=sys.stderr, flush=True)
+        return record
+
+
+def make_monitor(config) -> Optional[StragglerMonitor]:
+    """A monitor when the job is multi-process and the threshold is
+    positive (``straggler_threshold=0`` opts out), else None — the loop
+    then runs zero cross-host code."""
+    import jax
+
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return None
+    threshold = float(getattr(config, "straggler_threshold", 1.5) or 0.0)
+    if threshold <= 0:
+        return None
+    return StragglerMonitor(threshold, nproc)

@@ -1,0 +1,305 @@
+"""Always-on phase telemetry: spans/counters/gauges -> Chrome-trace JSON.
+
+Horovod answered "where does a step's time go?" with HOROVOD_TIMELINE — a
+Chrome-trace file of per-tensor collective phases (PAPERS.md: Horovod,
+arXiv:1802.05799). This module is that layer for the whole framework: the
+train loop records per-step *phase spans* (data_wait / dispatch /
+fetch_barrier / checkpoint_save / eval), the collective layers record
+per-bucket spans, and the fault/restart machinery records instant events,
+all into one bounded ring buffer of monotonic-clock events that exports as
+Chrome-trace JSON (``chrome://tracing``, Perfetto, or TensorBoard's trace
+viewer load it directly).
+
+Design constraints, in order:
+
+1. **Cheap enough to leave on.** Events are (name, int-microseconds, small
+   dict) tuples appended to a ``collections.deque(maxlen=...)`` under a
+   lock — no device fetches, no I/O until :meth:`Telemetry.export`. The
+   *disabled* path is a true no-op: ``span()`` returns a shared do-nothing
+   context manager (no allocation) and every record method returns after
+   one attribute check, so an uninstrumented run pays a few nanoseconds
+   per call site (bounded by a tier-1 test and the gated chip_window A/B).
+2. **Importable everywhere.** Pure stdlib: the launcher (which must never
+   import jax — children own the accelerator) and robustness/faults.py
+   record through the same API as the train loop.
+3. **Mergeable.** ``export`` folds its events into any trace file already
+   at the destination path, so the attempts of a restart-recovered chaos
+   run and the launcher's own restart/backoff instants accumulate into ONE
+   valid Chrome-trace JSON. All timestamps are CLOCK_MONOTONIC (shared
+   across processes on one host), so merged events stay ordered.
+
+The module-level singleton (:func:`get` / :func:`configure`) is what the
+instrumentation sites use; tests construct :class:`Telemetry` directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+DEFAULT_MAX_EVENTS = 200_000
+
+
+def now_s() -> float:
+    """Monotonic seconds — the clock every span endpoint must come from."""
+    return time.monotonic()
+
+
+def trace_path(trace_dir: str, process_index: int) -> str:
+    """Canonical per-process trace file: one file per training process;
+    the launcher merges its own events into process 0's file."""
+    return os.path.join(trace_dir, f"trace.p{process_index}.json")
+
+
+class _NullSpan:
+    """Shared no-op span: the entire disabled/off-window code path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tele", "_name", "_args", "_t0")
+
+    def __init__(self, tele: "Telemetry", name: str, args: dict):
+        self._tele, self._name, self._args = tele, name, args
+
+    def __enter__(self):
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._tele._emit({
+            "name": self._name, "ph": "X", "ts": self._t0 // 1000,
+            "dur": max((time.monotonic_ns() - self._t0) // 1000, 0),
+            "pid": self._tele.process_index,
+            "tid": threading.get_ident() & 0xFFFF,
+            "args": self._args})
+        return False
+
+
+class Telemetry:
+    """Thread-safe span/counter/gauge registry over a bounded ring buffer.
+
+    ``trace_steps=(lo, hi)`` restricts *step-tagged* events to the
+    half-open window [lo, hi); events with no step (bucket trace spans,
+    fault/restart instants) are always kept. ``max_events`` bounds memory:
+    the deque drops the oldest events, so a long run's export holds the
+    most recent window — the part a post-mortem wants.
+    """
+
+    def __init__(self, enabled: bool = False,
+                 trace_dir: Optional[str] = None,
+                 trace_steps: Optional[tuple[int, int]] = None,
+                 max_events: int = DEFAULT_MAX_EVENTS,
+                 process_index: int = 0,
+                 process_name: str = "ddl"):
+        self.enabled = bool(enabled)
+        self.trace_dir = trace_dir
+        self.trace_steps = tuple(trace_steps) if trace_steps else None
+        self.process_index = int(process_index)
+        self.process_name = process_name
+        self._events: deque = deque(maxlen=max(int(max_events), 1))
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+
+    # -- recording ----------------------------------------------------------
+
+    def _in_window(self, step: Optional[int]) -> bool:
+        if self.trace_steps is None or step is None:
+            return True
+        lo, hi = self.trace_steps
+        return lo <= step < hi
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def span(self, name: str, *, step: Optional[int] = None, **args: Any):
+        """Context manager timing a phase; ``with tele.span("data_wait",
+        step=i): ...``. Returns the shared no-op span when disabled or when
+        ``step`` falls outside the trace window."""
+        if not self.enabled or not self._in_window(step):
+            return _NULL_SPAN
+        if step is not None:
+            args["step"] = step
+        return _Span(self, name, args)
+
+    def record_span(self, name: str, start_s: float, end_s: float, *,
+                    step: Optional[int] = None, **args: Any) -> None:
+        """Record an already-measured span from two :func:`now_s` readings
+        — for call sites that time unconditionally (the hot loop shares one
+        clock read between telemetry and the straggler monitor) or that
+        only decide to record after the fact (checkpoint_save records only
+        when a save actually launched)."""
+        if not self.enabled or not self._in_window(step):
+            return
+        if step is not None:
+            args["step"] = step
+        self._emit({
+            "name": name, "ph": "X", "ts": int(start_s * 1e6),
+            "dur": max(int((end_s - start_s) * 1e6), 0),
+            "pid": self.process_index,
+            "tid": threading.get_ident() & 0xFFFF, "args": args})
+
+    def instant(self, name: str, *, step: Optional[int] = None,
+                **args: Any) -> None:
+        """A zero-duration marker (fault fired, restart scheduled, ...)."""
+        if not self.enabled:
+            return
+        if step is not None:
+            args["step"] = step
+        self._emit({
+            "name": name, "ph": "i", "s": "p",
+            "ts": time.monotonic_ns() // 1000, "pid": self.process_index,
+            "tid": threading.get_ident() & 0xFFFF, "args": args})
+
+    def gauge(self, name: str, value, *, step: Optional[int] = None) -> None:
+        """A sampled value (HBM bytes, queue depth) -> Chrome counter
+        track."""
+        if not self.enabled or not self._in_window(step):
+            return
+        self._emit({
+            "name": name, "ph": "C", "ts": time.monotonic_ns() // 1000,
+            "pid": self.process_index, "tid": 0,
+            "args": {"value": float(value)}})
+
+    def counter(self, name: str, inc: float = 1.0, *,
+                step: Optional[int] = None) -> None:
+        """A monotonically accumulating count (faults fired, bad steps);
+        each increment emits the running total as a counter event."""
+        if not self.enabled:
+            return
+        with self._lock:
+            total = self._counters.get(name, 0.0) + float(inc)
+            self._counters[name] = total
+        if not self._in_window(step):
+            return
+        self._emit({
+            "name": name, "ph": "C", "ts": time.monotonic_ns() // 1000,
+            "pid": self.process_index, "tid": 0,
+            "args": {"value": total}})
+
+    # -- inspection / export ------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """The buffered events, oldest first, without draining them."""
+        with self._lock:
+            return list(self._events)
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write (and DRAIN) the buffered events as Chrome-trace JSON.
+
+        Merges into an existing file at ``path`` — a restarted attempt or
+        the launcher folds its events into the same trace. Returns the
+        path written, or None when there is nowhere/nothing to write.
+        """
+        if path is None:
+            if self.trace_dir is None:
+                return None
+            path = trace_path(self.trace_dir, self.process_index)
+        with self._lock:
+            events = list(self._events)
+            self._events.clear()
+        if not events:
+            return None
+        existing: list = []
+        try:
+            with open(path) as fh:
+                prior = json.load(fh)
+            existing = (prior.get("traceEvents", [])
+                        if isinstance(prior, dict) else list(prior))
+        except (OSError, ValueError):
+            pass  # first write, or an unreadable prior file: start fresh
+        meta = []
+        if not any(e.get("ph") == "M" and e.get("pid") == self.process_index
+                   for e in existing):
+            meta.append({
+                "name": "process_name", "ph": "M", "ts": 0,
+                "pid": self.process_index,
+                "args": {"name":
+                         f"{self.process_name} p{self.process_index}"}})
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"traceEvents": existing + meta + events,
+                       "displayTimeUnit": "ms"}, fh)
+        os.replace(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Module singleton — what the instrumentation sites record through.
+# ---------------------------------------------------------------------------
+
+_active = Telemetry()
+
+
+def get() -> Telemetry:
+    return _active
+
+
+def configure(enabled: Optional[bool] = None,
+              trace_dir: Optional[str] = None,
+              trace_steps: Optional[tuple[int, int]] = None,
+              max_events: int = DEFAULT_MAX_EVENTS,
+              process_index: int = 0,
+              process_name: str = "ddl") -> Telemetry:
+    """Install a fresh module-level registry (one per run). ``enabled``
+    defaults to "a trace destination was given"."""
+    global _active
+    if enabled is None:
+        enabled = trace_dir is not None or trace_steps is not None
+    _active = Telemetry(enabled=enabled, trace_dir=trace_dir,
+                        trace_steps=trace_steps, max_events=max_events,
+                        process_index=process_index,
+                        process_name=process_name)
+    return _active
+
+
+def reset() -> None:
+    """Back to the disabled singleton (tests)."""
+    global _active
+    _active = Telemetry()
+
+
+# ---------------------------------------------------------------------------
+# Trace analysis helpers — shared by tools/summarize_trace.py and bench.py's
+# phase-breakdown record section.
+# ---------------------------------------------------------------------------
+
+def load_events(path: str) -> list[dict]:
+    """Events from a Chrome-trace JSON file (object or bare-array form)."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    return obj.get("traceEvents", []) if isinstance(obj, dict) else list(obj)
+
+
+def phase_totals(events) -> dict[str, dict[str, float]]:
+    """Per-phase aggregate over the complete ("X") spans: count, total and
+    mean duration in milliseconds, keyed by span name, largest total
+    first."""
+    acc: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("ph") == "X":
+            acc.setdefault(e["name"], []).append(float(e.get("dur", 0)))
+    out = {}
+    for name, durs in sorted(acc.items(),
+                             key=lambda kv: -sum(kv[1])):
+        total_us = sum(durs)
+        out[name] = {"count": len(durs),
+                     "total_ms": total_us / 1000.0,
+                     "mean_ms": total_us / len(durs) / 1000.0}
+    return out

@@ -39,6 +39,8 @@ from typing import Any, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from distributeddeeplearning_tpu.observability import telemetry
+
 AxisNames = Union[str, tuple[str, ...]]
 
 DEFAULT_BUCKET_MB = 4.0
@@ -181,28 +183,41 @@ def all_reduce(tree, axis_names: AxisNames, *, axis_size: int,
             f"plan was built for {plan.num_leaves} leaves, tree has "
             f"{len(leaves)}")
     out: list[Any] = [None] * len(leaves)
-    for members in plan.buckets:
+    tele = telemetry.get()
+    for b, members in enumerate(plan.buckets):
         sizes = _leaf_sizes(plan, members)
-        if len(members) == 1 and payload_dtype is None:
-            # Single-leaf bucket with no dtype policy: skip the
-            # ravel/concat round-trip entirely.
-            i = members[0]
-            out[i] = _reduce_flat(leaves[i].ravel(), axis_names, algorithm,
-                                  axis_size).reshape(plan.shapes[i])
-            continue
-        # Concatenation needs one dtype; with no explicit payload policy,
-        # promote to the bucket's widest member so mixed-dtype buckets
-        # never silently downcast a leaf's payload.
-        common = (jnp.dtype(payload_dtype) if payload_dtype is not None
-                  else jnp.result_type(*(plan.dtypes[i] for i in members)))
-        parts = [leaves[i].ravel().astype(common) for i in members]
-        buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-        red = _reduce_flat(buf, axis_names, algorithm, axis_size)
-        offset = 0
-        for i, n in zip(members, sizes):
-            piece = jax.lax.dynamic_slice_in_dim(red, offset, n, 0)
-            out[i] = piece.reshape(plan.shapes[i]).astype(plan.dtypes[i])
-            offset += n
+        # named_scope labels this bucket's collective in device profiles
+        # (jax.profiler / XLA HLO names); the telemetry span runs at TRACE
+        # time (once per compile, cat="trace") and carries the bucket's
+        # shape metadata into the Chrome trace alongside the runtime
+        # phases. Runtime per-bucket device timing lives in the profiler
+        # trace — a host-side span cannot see inside one XLA program.
+        scope = f"allreduce/bucket{b:02d}"
+        with tele.span(f"collective:{scope}", cat="trace",
+                       leaves=len(members), elems=sum(sizes)), \
+                jax.named_scope(scope):
+            if len(members) == 1 and payload_dtype is None:
+                # Single-leaf bucket with no dtype policy: skip the
+                # ravel/concat round-trip entirely.
+                i = members[0]
+                out[i] = _reduce_flat(leaves[i].ravel(), axis_names,
+                                      algorithm,
+                                      axis_size).reshape(plan.shapes[i])
+                continue
+            # Concatenation needs one dtype; with no explicit payload
+            # policy, promote to the bucket's widest member so mixed-dtype
+            # buckets never silently downcast a leaf's payload.
+            common = (jnp.dtype(payload_dtype) if payload_dtype is not None
+                      else jnp.result_type(
+                          *(plan.dtypes[i] for i in members)))
+            parts = [leaves[i].ravel().astype(common) for i in members]
+            buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            red = _reduce_flat(buf, axis_names, algorithm, axis_size)
+            offset = 0
+            for i, n in zip(members, sizes):
+                piece = jax.lax.dynamic_slice_in_dim(red, offset, n, 0)
+                out[i] = piece.reshape(plan.shapes[i]).astype(plan.dtypes[i])
+                offset += n
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

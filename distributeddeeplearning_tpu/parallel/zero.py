@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributeddeeplearning_tpu.observability import telemetry
 from distributeddeeplearning_tpu.parallel.collectives import (
     _MB, AxisNames, BucketPlan, DEFAULT_BUCKET_MB, _numel, plan_buckets)
 
@@ -193,23 +194,32 @@ def reduce_scatter(tree, layout: Zero1Layout, axis_names: AxisNames, *,
     _check_leaves(layout, len(leaves))
     n = layout.axis_size
     out: list[Any] = [None] * len(leaves)
-    for members in layout.plan.buckets:
-        common = (jnp.dtype(payload_dtype) if payload_dtype is not None
-                  else jnp.result_type(
-                      *(layout.plan.dtypes[i] for i in members)))
-        parts = []
-        for i in members:
-            flat = _pad_flat(leaves[i].astype(common), layout.padded_size(i))
-            parts.append(flat.reshape(n, layout.chunk_sizes[i]))
-        row = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
-        chunk = jax.lax.psum_scatter(row.reshape(-1), axis_names,
-                                     scatter_dimension=0, tiled=True)
-        off = 0
-        for i in members:
-            c = layout.chunk_sizes[i]
-            piece = jax.lax.dynamic_slice_in_dim(chunk, off, c, 0)
-            out[i] = piece.astype(layout.plan.dtypes[i])
-            off += c
+    tele = telemetry.get()
+    for b, members in enumerate(layout.plan.buckets):
+        # Same per-bucket annotation scheme as collectives.all_reduce:
+        # named_scope for device profiles, a trace-time telemetry span
+        # (cat="trace") for the Chrome trace.
+        scope = f"zero1/reduce_scatter/bucket{b:02d}"
+        with tele.span(f"collective:{scope}", cat="trace",
+                       leaves=len(members)), jax.named_scope(scope):
+            common = (jnp.dtype(payload_dtype) if payload_dtype is not None
+                      else jnp.result_type(
+                          *(layout.plan.dtypes[i] for i in members)))
+            parts = []
+            for i in members:
+                flat = _pad_flat(leaves[i].astype(common),
+                                 layout.padded_size(i))
+                parts.append(flat.reshape(n, layout.chunk_sizes[i]))
+            row = (parts[0] if len(parts) == 1
+                   else jnp.concatenate(parts, axis=1))
+            chunk = jax.lax.psum_scatter(row.reshape(-1), axis_names,
+                                         scatter_dimension=0, tiled=True)
+            off = 0
+            for i in members:
+                c = layout.chunk_sizes[i]
+                piece = jax.lax.dynamic_slice_in_dim(chunk, off, c, 0)
+                out[i] = piece.astype(layout.plan.dtypes[i])
+                off += c
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -226,20 +236,25 @@ def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames):
     _check_leaves(layout, len(leaves))
     n = layout.axis_size
     out: list[Any] = [None] * len(leaves)
-    for members in layout.plan.buckets:
-        common = jnp.result_type(*(layout.plan.dtypes[i] for i in members))
-        parts = [leaves[i].astype(common) for i in members]
-        row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        full = jax.lax.all_gather(row, axis_names, tiled=True)
-        mat = full.reshape(n, -1)
-        off = 0
-        for i in members:
-            c = layout.chunk_sizes[i]
-            shape = layout.plan.shapes[i]
-            piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
-            out[i] = (piece.reshape(n * c)[:_numel(shape)].reshape(shape)
-                      .astype(layout.plan.dtypes[i]))
-            off += c
+    tele = telemetry.get()
+    for b, members in enumerate(layout.plan.buckets):
+        scope = f"zero1/all_gather/bucket{b:02d}"
+        with tele.span(f"collective:{scope}", cat="trace",
+                       leaves=len(members)), jax.named_scope(scope):
+            common = jnp.result_type(
+                *(layout.plan.dtypes[i] for i in members))
+            parts = [leaves[i].astype(common) for i in members]
+            row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            full = jax.lax.all_gather(row, axis_names, tiled=True)
+            mat = full.reshape(n, -1)
+            off = 0
+            for i in members:
+                c = layout.chunk_sizes[i]
+                shape = layout.plan.shapes[i]
+                piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
+                out[i] = (piece.reshape(n * c)[:_numel(shape)]
+                          .reshape(shape).astype(layout.plan.dtypes[i]))
+                off += c
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

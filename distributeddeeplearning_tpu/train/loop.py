@@ -23,6 +23,8 @@ from distributeddeeplearning_tpu.config import TrainConfig
 from distributeddeeplearning_tpu import data as datalib
 from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
+from distributeddeeplearning_tpu.observability import health, telemetry
+from distributeddeeplearning_tpu.observability import straggler as stragglib
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero as zerolib
@@ -282,7 +284,20 @@ def run(config: TrainConfig, *, total_steps: int,
     (SURVEY.md §3.5): sharded top-1 for image models, mean per-token loss
     (perplexity) for token models.
     """
+    owns_logger = logger is None
     logger = logger or MetricLogger()
+    # A caller-reused logger (in-process restart harnesses) must not turn
+    # the wall time spent between runs — teardown, restore, recompile —
+    # into this run's first throughput sample.
+    logger.reset_throughput()
+    # Telemetry is configured BEFORE the first compile so the collective
+    # layers' trace-time bucket spans land in the buffer; export runs in the
+    # finally below, so a faulting run (crash/SIGTERM/abort) still writes
+    # its trace — the runs a post-mortem needs most.
+    tele = telemetry.configure(
+        trace_dir=config.trace_dir, trace_steps=config.trace_steps,
+        max_events=config.trace_max_events,
+        process_index=jax.process_index())
     spec = model_spec(config.model)
     mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
@@ -298,6 +313,12 @@ def run(config: TrainConfig, *, total_steps: int,
     finally:
         if ckpt is not None:
             ckpt.close()  # releases the async-checkpointing executor
+        if owns_logger:
+            logger.close()  # guaranteed JSONL/TB handle release
+        trace_file = tele.export()
+        if trace_file is not None:
+            print(f"# telemetry trace written to {trace_file}",
+                  file=sys.stderr, flush=True)
 
 
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
@@ -405,6 +426,10 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         points = [start_step + warmup_steps, *fault_plan.boundary_steps()]
         if config.profile_steps is not None:
             points.extend(config.profile_steps)
+        if config.trace_steps is not None:
+            # Fused blocks split at the telemetry window's edges, so its
+            # step-tagged spans cover exactly the requested steps.
+            points.extend(config.trace_steps)
         cands.extend(a for a in points if a is not None and a > pos)
         return min(c for c in cands if c > pos)
 
@@ -432,12 +457,29 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
+    # Phase telemetry (observability/telemetry.py; configured in run()):
+    # host-side monotonic timestamps only — no device fetches on non-log
+    # steps, and the disabled singleton makes record_span a single attribute
+    # check. The heartbeat writer (observability/health.py) exists iff the
+    # launcher exported DDL_HEARTBEAT_DIR; the straggler monitor
+    # (observability/straggler.py) iff the job is multi-process.
+    tele = telemetry.get()
+    heartbeat = health.HeartbeatWriter.from_env()
+    straggler = stragglib.make_monitor(config)
+    phase_clock = tele.enabled or straggler is not None
+    data_wait_acc = 0.0             # seconds in source.batch since last log
+    t_last_log = telemetry.now_s()  # log-interval origin for straggler math
+    steps_at_last_log = start_step
+    if heartbeat is not None:
+        heartbeat.beat(start_step)  # arm the watchdog before compile
     # warmup_steps == 0 means "time everything" (incl. compile).
     t_timed = time.perf_counter() if warmup_steps == 0 else None
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
             if preempted["signum"] is not None:
+                tele.instant("preempted", step=i,
+                             signum=preempted["signum"])
                 ckpt.maybe_save(i, state, force=True)
                 ckpt.wait()
                 raise SystemExit(
@@ -447,9 +489,25 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                  if fused_runner is not None else 1)
             profile.before_step(i)
             if n == 1:
-                state, metrics = train_step(state, source.batch(i), rng)
+                if phase_clock:
+                    t0 = telemetry.now_s()
+                    batch = source.batch(i)
+                    t1 = telemetry.now_s()
+                    tele.record_span("data_wait", t0, t1, step=i)
+                    data_wait_acc += t1 - t0
+                    state, metrics = train_step(state, batch, rng)
+                    tele.record_span("dispatch", t1, telemetry.now_s(),
+                                     step=i)
+                else:
+                    state, metrics = train_step(state, source.batch(i), rng)
             else:
-                state, metrics = fused_runner(state, rng, i, n)
+                if phase_clock:
+                    t1 = telemetry.now_s()
+                    state, metrics = fused_runner(state, rng, i, n)
+                    tele.record_span("dispatch", t1, telemetry.now_s(),
+                                     step=i, fused_steps=n)
+                else:
+                    state, metrics = fused_runner(state, rng, i, n)
             i += n
             profile.after_step(i - 1, metrics)
             bad_tracker.push(metrics)
@@ -462,21 +520,46 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 jax.device_get(metrics)
                 t_timed = time.perf_counter()
             if i % config.log_every == 0 or i == total_steps:
+                extra = {}
+                t_log = telemetry.now_s()
+                interval_steps = max(i - steps_at_last_log, 1)
+                if straggler is not None:
+                    # One small allgather per log step, on EVERY process at
+                    # the same step — a collective, like the eval syncs.
+                    extra = straggler.collect(
+                        int(i), (t_log - t_last_log) / interval_steps,
+                        data_wait_acc / interval_steps)
                 # logger floats every metric (a true fetch barrier); no
-                # separate block needed.
-                logger.log(int(i), metrics,
-                           examples_per_step=config.global_batch_size,
-                           lr=float(sched(i - 1)))
+                # separate block needed. Its span is therefore the device
+                # time of the steps still in flight — log-cadence only, so
+                # telemetry adds no fetch of its own.
+                with tele.span("fetch_barrier", step=int(i)):
+                    logger.log(int(i), metrics,
+                               examples_per_step=config.global_batch_size,
+                               lr=float(sched(i - 1)), **extra)
+                if heartbeat is not None:
+                    heartbeat.beat(int(i))
+                if tele.enabled:
+                    _record_hbm_gauges(tele, int(i))
+                t_last_log, steps_at_last_log = telemetry.now_s(), i
+                data_wait_acc = 0.0
             if done > warmup_steps:
                 # Blocks never straddle the warmup edge (it is a boundary),
                 # so the whole block counts toward the timed window.
                 timed_examples += config.global_batch_size * n
             if ckpt is not None:
-                ckpt.maybe_save(i, state)
+                t_ck = telemetry.now_s() if tele.enabled else 0.0
+                if ckpt.maybe_save(i, state) and tele.enabled:
+                    # Recorded only when a save actually launched (async:
+                    # the span is the launch + state-gather cost, not the
+                    # full write).
+                    tele.record_span("checkpoint_save", t_ck,
+                                     telemetry.now_s(), step=int(i))
             if (eval_every_steps and i % eval_every_steps == 0
                     and i < total_steps):
                 t_eval = time.perf_counter()
-                val = evaluator(state)
+                with tele.span("eval", step=int(i)):
+                    val = evaluator(state)
                 evals.append((i, val))
                 logger.log(int(i), {evaluator.metric_name: val})
                 if t_timed is not None:
@@ -604,6 +687,20 @@ class _BadStepTracker:
                     f"{self.total} update(s) were skipped in total.")
         else:
             self._consecutive = 0
+
+
+def _record_hbm_gauges(tele, step: int) -> None:
+    """Periodic HBM telemetry (log cadence, telemetry on): allocator stats
+    straight from ``memory_stats()`` — host-side bookkeeping, no device
+    fetch. Backends without allocator stats (CPU) record nothing."""
+    try:
+        for d, dev in enumerate(jax.local_devices()):
+            stats = dev.memory_stats() or {}
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                if key in stats:
+                    tele.gauge(f"hbm_{key}/d{d}", int(stats[key]), step=step)
+    except Exception:
+        pass
 
 
 def _device_memory_stats(state=None) -> Optional[dict]:

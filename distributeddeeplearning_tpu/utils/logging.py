@@ -42,9 +42,30 @@ class MetricLogger:
         self._last_time: Optional[float] = None
         self._last_step: Optional[int] = None
 
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def reset_throughput(self) -> None:
+        """Invalidate the step-time baseline. Called when wall time between
+        two log calls stops meaning training time — a restart-resumed run
+        reusing this logger would otherwise fold restore/compile downtime
+        into its first throughput sample."""
+        self._last_time = None
+        self._last_step = None
+
     def log(self, step: int, metrics: dict[str, Any], *,
             examples_per_step: Optional[int] = None, **extra: Any) -> dict:
         now = time.perf_counter()
+        if self._last_step is not None and step < self._last_step:
+            # Non-monotonic step (restart resumed from an earlier
+            # checkpoint): the elapsed time since the pre-restart log is
+            # not step time — drop the baseline instead of emitting a
+            # garbage sample at the next log.
+            self.reset_throughput()
         record: dict[str, Any] = {"step": int(step)}
         for k, v in metrics.items():
             record[k] = float(v) if hasattr(v, "__float__") else v
@@ -72,7 +93,12 @@ class MetricLogger:
         return record
 
     def close(self) -> None:
-        if self.file:
-            self.file.close()
-        if self._tb is not None:
-            self._tb.close()
+        """Release the JSONL file and TB writer; idempotent, and each
+        handle is dropped before closing so a failed close cannot leave a
+        half-closed logger that double-closes later."""
+        f, self.file = self.file, None
+        if f is not None:
+            f.close()
+        tb, self._tb = self._tb, None
+        if tb is not None:
+            tb.close()

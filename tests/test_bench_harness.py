@@ -334,12 +334,24 @@ def test_suite_order_contract_for_chip_window(bench):
 
 def test_suite_rows_validation(bench, capsys):
     with pytest.raises(SystemExit):
-        bench.main(["--suite", "--suite-rows", "0,99"])  # not names
+        # 99 is out of range even as a deprecated positional index
+        bench.main(["--suite", "--suite-rows", "0,99"])
     with pytest.raises(SystemExit):
         bench.main(["--suite", "--suite-rows", "resnet50,nope"])
     with pytest.raises(SystemExit):
         bench.main(["--suite", "--suite-rows", "bert512",
                     "--suite-models", "resnet50"])
+
+
+def test_suite_rows_index_alias_deprecated(bench, capsys):
+    """Positional indices predate named rows: they still resolve (old
+    drivers keep working) but to the NAME at that suite position, with a
+    stderr deprecation note; a name+its-index pair dedupes to one row."""
+    names = [n for n, _m, _o, _e in bench.SUITE]
+    args = _args(bench, ["--suite", "--suite-rows", f"4,0,{names[0]}"])
+    assert args.suite_rows == f"{names[4]},{names[0]}"
+    err = capsys.readouterr().err
+    assert "deprecated" in err and names[4] in err
 
 
 def test_suite_budget_zero_disables_gating(bench, monkeypatch, capsys):

@@ -245,4 +245,24 @@ if [ "${DDL_CHAOS:-0}" = "1" ]; then
     > "$RES/chaos_recovery.json" 2>> "$RES/log.txt"
   note chaos
 fi
+
+# --- Gated telemetry-overhead A/B (ask with DDL_TELEMETRY=1) --------------
+# Same headline config traced vs untraced on the live chip: the traced run
+# lands under its own _tele metric name, so the pair quantifies the cost of
+# leaving --trace-dir on (docs/observability.md records the bound; a CPU
+# tier-1 test bounds the disabled path's overhead). The trace itself is
+# kept in $RES for tools/summarize_trace.py.
+if [ "${DDL_TELEMETRY:-0}" = "1" ]; then
+  check_stop telemetry_off
+  timeout 420 python bench.py --budget 400 --attempts 1 --sweep none \
+    > "$RES/bench_tele_off.json" 2>> "$RES/log.txt"
+  note telemetry_off
+  check_stop telemetry_on
+  timeout 420 python bench.py --budget 400 --attempts 1 --sweep none \
+    --trace-dir "$RES/trace" \
+    > "$RES/bench_tele_on.json" 2>> "$RES/log.txt"
+  note telemetry_on
+  python tools/summarize_trace.py "$RES"/trace/trace.p*.json \
+    >> "$RES/log.txt" 2>&1 || true
+fi
 echo "[$(stamp)] window done" >> "$RES/log.txt"

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Per-phase breakdown of a telemetry Chrome-trace JSON.
+
+    python tools/summarize_trace.py TRACE.json [TRACE2.json ...] [--json]
+
+Reads trace files written by --trace-dir (train.py, bench.py, or a
+launch.py-merged chaos run) and prints, per file set:
+
+  * the per-phase span table — count, total ms, mean ms, and share of
+    the summed span time (where does a step's wall clock go?);
+  * the instant-event timeline — faults fired, launcher restarts,
+    straggler warnings, preemptions — in monotonic-clock order;
+  * counter tracks (HBM gauges, cumulative counts) as last-value + peak.
+
+``--json`` emits one machine-readable object instead of the tables.
+Pure stdlib + the telemetry module's loaders; no jax, safe anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.observability import telemetry  # noqa: E402
+
+
+def summarize(paths: list[str]) -> dict:
+    events: list[dict] = []
+    for p in paths:
+        events.extend(telemetry.load_events(p))
+    phases = telemetry.phase_totals(events)
+    instants = sorted((e for e in events if e.get("ph") == "i"),
+                      key=lambda e: e.get("ts", 0))
+    counters: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "C":
+            continue
+        v = float(e.get("args", {}).get("value", 0.0))
+        c = counters.setdefault(e["name"], {"last": v, "peak": v, "n": 0})
+        c["last"] = v
+        c["peak"] = max(c["peak"], v)
+        c["n"] += 1
+    pids = sorted({e.get("pid") for e in events if "pid" in e})
+    return {
+        "files": paths,
+        "events": len(events),
+        "processes": pids,
+        "phases": phases,
+        "instants": [{"name": e["name"], "ts_us": e.get("ts", 0),
+                      "pid": e.get("pid"), "args": e.get("args", {})}
+                     for e in instants],
+        "counters": counters,
+    }
+
+
+def print_tables(s: dict) -> None:
+    total_ms = sum(p["total_ms"] for p in s["phases"].values()) or 1.0
+    print(f"{len(s['files'])} file(s), {s['events']} events, "
+          f"processes {s['processes']}")
+    if s["phases"]:
+        print(f"\n{'phase':<40}{'count':>8}{'total_ms':>12}"
+              f"{'mean_ms':>10}{'share':>8}")
+        for name, p in s["phases"].items():
+            print(f"{name:<40}{p['count']:>8}{p['total_ms']:>12.2f}"
+                  f"{p['mean_ms']:>10.3f}"
+                  f"{p['total_ms'] / total_ms:>8.1%}")
+    else:
+        print("\nno complete spans")
+    if s["instants"]:
+        print("\ninstant events (monotonic order):")
+        for e in s["instants"]:
+            args = {k: v for k, v in e["args"].items()}
+            print(f"  {e['ts_us'] / 1e6:>12.3f}s  p{e['pid']}  "
+                  f"{e['name']}  {json.dumps(args) if args else ''}".rstrip())
+    if s["counters"]:
+        print("\ncounters (last / peak / samples):")
+        for name in sorted(s["counters"]):
+            c = s["counters"][name]
+            print(f"  {name:<40}{c['last']:>16g}{c['peak']:>16g}"
+                  f"{c['n']:>8}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("traces", nargs="+",
+                   help="Chrome-trace JSON file(s) from --trace-dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON object instead "
+                        "of tables")
+    args = p.parse_args(argv)
+    missing = [t for t in args.traces if not os.path.exists(t)]
+    if missing:
+        p.error(f"no such trace file(s): {missing}")
+    s = summarize(args.traces)
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print_tables(s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import sys
@@ -146,6 +147,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="capture a jax.profiler trace of steps [A,B)")
     p.add_argument("--profile-dir", default=None,
                    help="trace output dir (default /tmp/ddl_tpu_profile)")
+    p.add_argument("--trace-dir", default=None,
+                   help="always-on phase telemetry: per-step phase spans, "
+                        "per-bucket collective spans, fault/restart "
+                        "instants, HBM gauges exported here as Chrome-trace "
+                        "JSON (one file per process; read with "
+                        "tools/summarize_trace.py or chrome://tracing)")
+    p.add_argument("--trace-steps", default=None, metavar="A,B",
+                   help="restrict step-tagged telemetry events to steps "
+                        "[A,B) (default: the whole run)")
+    p.add_argument("--straggler-threshold", type=float, default=None,
+                   help="multi-host: warn when a host's log-cadence step "
+                        "time exceeds this multiple of the cross-host mean "
+                        "(default 1.5; 0 disables the per-log allgather)")
     p.add_argument("--fail-at-step", type=int, default=None,
                    help="DEPRECATED alias for --fault-plan crash@K "
                         "(fires on every restart attempt)")
@@ -253,6 +267,23 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(profile_steps=(lo, hi))
     if args.profile_dir:
         cfg = cfg.replace(profile_dir=args.profile_dir)
+    if args.trace_dir:
+        cfg = cfg.replace(trace_dir=args.trace_dir)
+    if args.trace_steps:
+        try:
+            lo, hi = (int(x) for x in args.trace_steps.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--trace-steps expects A,B (got {args.trace_steps!r})")
+        if not 0 <= lo < hi:
+            raise SystemExit(
+                f"--trace-steps needs 0 <= A < B (got {lo},{hi})")
+        cfg = cfg.replace(trace_steps=(lo, hi))
+    if args.straggler_threshold is not None:
+        if args.straggler_threshold < 0:
+            raise SystemExit(f"--straggler-threshold must be >= 0 "
+                             f"(got {args.straggler_threshold})")
+        cfg = cfg.replace(straggler_threshold=args.straggler_threshold)
 
     par = cfg.parallel
     updates = {}
@@ -416,16 +447,20 @@ def main(argv=None) -> int:
                 "pass --steps or set steps_per_epoch in the config")
         total_steps = int(cfg.num_epochs * steps_per_epoch)
 
-    logger = None
+    logger_cm = contextlib.nullcontext(None)
     if args.tensorboard_dir:
         from distributeddeeplearning_tpu.utils.logging import MetricLogger
-        logger = MetricLogger(tensorboard_dir=args.tensorboard_dir)
+        # Context manager: the TB writer / JSONL handle is released even
+        # when the loop raises (preemption SystemExit, injected faults).
+        logger_cm = MetricLogger(tensorboard_dir=args.tensorboard_dir)
 
-    summary = loop.run(cfg, total_steps=total_steps,
-                       warmup_steps=min(args.warmup_steps, total_steps - 1)
-                       if total_steps > 1 else 0,
-                       eval_batches=args.eval_batches, logger=logger,
-                       restore_for_eval=args.eval_only)
+    with logger_cm as logger:
+        summary = loop.run(cfg, total_steps=total_steps,
+                           warmup_steps=min(args.warmup_steps,
+                                            total_steps - 1)
+                           if total_steps > 1 else 0,
+                           eval_batches=args.eval_batches, logger=logger,
+                           restore_for_eval=args.eval_only)
     if args.eval_only and summary["start_step"] == 0:
         # Backstop for a checkpoint that vanished between the pre-check and
         # the restore: never report a random-init score as a valid summary.

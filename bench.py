@@ -59,8 +59,17 @@ RETRY_BACKOFF_SEC = (5, 15)  # sleeps between attempts
 # this substring, so the child's backend-up note and the parent's matcher
 # must never drift apart.
 BACKEND_UP_HEARTBEAT = "backend up:"
-COMPILE_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 ".cache", "jax_compile")
+def _compile_cache_dir(explicit=None):
+    """Shared persistent-cache resolution (perf/compile_cache.py): flag >
+    $DDL_COMPILE_CACHE > repo-local default; None = disabled. Guarded
+    import: the bench parent must keep running (and relaying child errors)
+    even when the package itself is broken."""
+    try:
+        from distributeddeeplearning_tpu.perf import compile_cache
+        return compile_cache.resolve_dir(explicit)
+    except Exception:
+        return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".cache", "jax_compile")
 
 # --suite rows: (name, model, overrides, est_s) in VALUE-PER-MINUTE order —
 # a window that dies mid-suite yields the most valuable prefix (VERDICT r4
@@ -326,6 +335,7 @@ def _child_measure(args, emit_quick: bool = True,
     total = quick_w + quick_n + args.steps
     _note(f"building {args.model} batch={cfg.global_batch_size} on "
           f"{n_dev} device(s)")
+    t_row0 = time.perf_counter()
     mesh, model, batch_shd, state, train_step, sched, rng = loop.build(
         cfg, total)
     source = datalib.make_source(cfg, spec.input_kind, batch_shd,
@@ -333,8 +343,16 @@ def _child_measure(args, emit_quick: bool = True,
     t_compile = time.perf_counter()
     i = 0
     metrics = None
+    compile_time_s = time_to_first_step_s = None
     for _ in range(quick_w):
+        t_step0 = time.perf_counter() if i == 0 else None
         state, metrics = train_step(state, source.batch(i), rng)
+        if t_step0 is not None:
+            # First dispatch blocks the host for trace+compile (or the AOT
+            # load); the fetch barrier closes the cold-start window.
+            compile_time_s = time.perf_counter() - t_step0
+            jax.device_get(metrics)
+            time_to_first_step_s = time.perf_counter() - t_row0
         i += 1
     # device_get, not block_until_ready: a fetch is a true execution barrier
     # on every backend (remote-tunneled devices can report buffers "ready"
@@ -393,12 +411,23 @@ def _child_measure(args, emit_quick: bool = True,
                 break
         return done, time.perf_counter() - t0
 
+    # Cold-start annotations (docs/compile_cache.md): every record carries
+    # the row's compile cost and whether the AOT executable cache served it.
+    cold = {}
+    if compile_time_s is not None:
+        cold["compile_time_s"] = round(compile_time_s, 2)
+        cold["time_to_first_step_s"] = round(time_to_first_step_s, 2)
+        aot = getattr(train_step, "aot", None)
+        if aot is not None and aot.enabled:
+            cold["aot_source"] = aot.sources.get("dp_train_step", "n/a")
+
     def row_extra() -> dict:
-        """Per-line annotations: memory, plus (traced rows) the phase
-        breakdown aggregated from the buffered spans so far."""
+        """Per-line annotations: memory + cold-start, plus (traced rows)
+        the phase breakdown aggregated from the buffered spans so far."""
         if tele is None:
-            return mem
-        return {**mem, "phases": telemetry.phase_totals(tele.snapshot())}
+            return {**mem, **cold}
+        return {**mem, **cold,
+                "phases": telemetry.phase_totals(tele.snapshot())}
 
     # Protocol marker: chunked barriers are measurement-protocol drift vs
     # the barrier-free round-2/3 windows (one pipeline drain per 5 steps
@@ -466,8 +495,11 @@ def _child(args) -> int:
         os.environ["JAX_PLATFORMS"] = args.platform
         jax.config.update("jax_platforms", args.platform)
     try:
-        os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+        from distributeddeeplearning_tpu.perf import compile_cache
+        cache_dir = compile_cache.activate(
+            getattr(args, "compile_cache_dir", None))
+        if cache_dir:
+            _note(f"compile cache at {cache_dir}")
     except Exception as e:  # cache is an optimization, never fatal
         _note(f"compilation cache disabled: {e}")
 
@@ -639,6 +671,20 @@ def _emit_error(args, msg: str) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _last_summary(stdout: str):
+    """Last ``{"summary": ...}`` line a train.py child printed, or None.
+    Under ``launch.py --max-restarts`` the crashed attempt prints no
+    summary, so the last one belongs to the attempt that finished."""
+    for line in reversed((stdout or "").splitlines()):
+        if '"summary"' not in line:
+            continue
+        try:
+            return json.loads(line)["summary"]
+        except (ValueError, KeyError, TypeError):
+            continue
+    return None
+
+
 def _run_chaos(args) -> int:
     """Chaos recovery benchmark (CPU, no chip needed): run the same tiny
     synthetic job twice — once clean, once killed by fault injection at
@@ -646,7 +692,14 @@ def _run_chaos(args) -> int:
     overhead of surviving one fault (relaunch + backend re-init +
     re-compile + checkpoint restore + replayed steps). Deterministic on
     purpose: ``crash@F`` is attempt-scoped (robustness/faults.py), so the
-    restarted attempt runs fault-free to completion."""
+    restarted attempt runs fault-free to completion.
+
+    All runs share one fresh compile cache (perf/compile_cache.py): the
+    clean run cold-compiles and populates it, so the faulted run's restart
+    attempt recovers *warm* — measuring the recovery path users actually
+    hit when the launcher exports the cache to every attempt. Pass
+    ``--chaos-cold`` to additionally rerun the faulted job with the cache
+    disabled and report the cold-recovery overhead next to the warm one."""
     import shutil
     import tempfile
 
@@ -660,9 +713,15 @@ def _run_chaos(args) -> int:
             flush=True)
         return 0
     root = tempfile.mkdtemp(prefix="ddl_chaos_")
+    cache = os.path.join(root, "cache")
+    os.makedirs(cache, exist_ok=True)
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
+    env_warm = dict(env, DDL_COMPILE_CACHE=cache,
+                    JAX_COMPILATION_CACHE_DIR=cache)
+    env_cold = dict(env, DDL_COMPILE_CACHE="off")
+    env_cold.pop("JAX_COMPILATION_CACHE_DIR", None)
 
     def train_cmd(ckpt_dir: str, extra: tuple = ()) -> list[str]:
         return [sys.executable, os.path.join(base, "train.py"),
@@ -681,24 +740,38 @@ def _run_chaos(args) -> int:
             flush=True)
         return 0
 
+    def faulted_run(tag: str, run_env: dict):
+        launch_cmd = [sys.executable, os.path.join(base, "launch.py"),
+                      "--num-processes", "1", "--max-restarts", "1",
+                      "--backoff", "0.2", "--",
+                      *train_cmd(os.path.join(root, tag),
+                                 ("--fault-plan", f"crash@{fail_at}"))]
+        t = time.monotonic()
+        proc = subprocess.run(launch_cmd, env=run_env, capture_output=True,
+                              text=True, timeout=420)
+        return time.monotonic() - t, proc
+
     try:
         t0 = time.monotonic()
+        populate = subprocess.run(
+            train_cmd(os.path.join(root, "populate")), env=env_warm,
+            capture_output=True, text=True, timeout=420)
+        w_populate = time.monotonic() - t0
+        if populate.returncode != 0:
+            return fail("populate", populate)
+
+        # The warm BASELINE must itself run warm: comparing a warm faulted
+        # run against the cold populate run would subtract the populate
+        # run's compile time and report a (nonsensical) negative overhead.
+        t0 = time.monotonic()
         clean = subprocess.run(
-            train_cmd(os.path.join(root, "clean")), env=env,
+            train_cmd(os.path.join(root, "clean")), env=env_warm,
             capture_output=True, text=True, timeout=420)
         w_clean = time.monotonic() - t0
         if clean.returncode != 0:
             return fail("clean", clean)
 
-        launch_cmd = [sys.executable, os.path.join(base, "launch.py"),
-                      "--num-processes", "1", "--max-restarts", "1",
-                      "--backoff", "0.2", "--",
-                      *train_cmd(os.path.join(root, "faulted"),
-                                 ("--fault-plan", f"crash@{fail_at}"))]
-        t1 = time.monotonic()
-        faulted = subprocess.run(launch_cmd, env=env, capture_output=True,
-                                 text=True, timeout=420)
-        w_faulted = time.monotonic() - t1
+        w_faulted, faulted = faulted_run("faulted", env_warm)
         if faulted.returncode != 0 or "restart 1/1" not in faulted.stderr:
             return fail("faulted", faulted)
 
@@ -706,7 +779,7 @@ def _run_chaos(args) -> int:
         # saves at step F before the injector kills it only when F is on
         # cadence, so the restart replays F - floor(F/every)*every steps.
         resumed_from = (fail_at // every) * every
-        print(json.dumps({
+        rec = {
             "metric": metric,
             "value": round(w_faulted - w_clean, 2),
             "unit": "s per fault",
@@ -714,12 +787,40 @@ def _run_chaos(args) -> int:
             "steps_lost": fail_at - resumed_from,
             "restarts": 1,
             "clean_s": round(w_clean, 1),
+            "clean_cold_s": round(w_populate, 1),
             "faulted_s": round(w_faulted, 1),
+            "cache": "warm",
             "protocol": (f"cpu resnet18_thin b8 {steps} steps, "
-                         f"crash@{fail_at}, ckpt every {every}; overhead = "
-                         f"relaunch + re-init + re-compile + restore + "
+                         f"crash@{fail_at}, ckpt every {every}, shared "
+                         f"compile cache (a populate run cold-compiles it, "
+                         f"then clean baseline, faulted run, and restart "
+                         f"all recover warm); overhead = relaunch + "
+                         f"re-init + cached compile + restore + "
                          f"{fail_at - resumed_from} replayed step(s)"),
-        }), flush=True)
+        }
+        # The restarted attempt's own cold-start telemetry (train/loop.py
+        # stamps both into the run summary the child prints on stdout).
+        summary = _last_summary(faulted.stdout)
+        if summary:
+            for k in ("compile_time_s", "time_to_first_step_s"):
+                if summary.get(k) is not None:
+                    rec[f"recovery_{k}"] = summary[k]
+
+        if getattr(args, "chaos_cold", False):
+            w_cold, cold = faulted_run("faulted_cold", env_cold)
+            if cold.returncode != 0 or "restart 1/1" not in cold.stderr:
+                return fail("faulted_cold", cold)
+            rec["faulted_cold_s"] = round(w_cold, 1)
+            # Cold-vs-cold: the cache-off faulted run's attempt 0 compiles
+            # cold too, so its baseline is the cold populate run.
+            rec["overhead_cold_s"] = round(w_cold - w_populate, 2)
+            rec["recovery_compile_saved_s"] = round(w_cold - w_faulted, 2)
+            cold_summary = _last_summary(cold.stdout)
+            if cold_summary:
+                for k in ("compile_time_s", "time_to_first_step_s"):
+                    if cold_summary.get(k) is not None:
+                        rec[f"recovery_cold_{k}"] = cold_summary[k]
+        print(json.dumps(rec), flush=True)
         return 0
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -757,7 +858,9 @@ def _run_attempt(child_cmd, timeout: float, *, relay_errors: bool,
     (the deadline disarms at the heartbeat, before compilation starts) and
     turns a dead-tunnel run from 3 x attempt_timeout of hangs into one
     short probe, leaving the driver's window open for a later retry."""
-    env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=COMPILE_CACHE_DIR)
+    # The shared cache env (DDL_COMPILE_CACHE / JAX_COMPILATION_CACHE_DIR)
+    # was exported by main() before the first attempt; children inherit it.
+    env = dict(os.environ)
     proc = subprocess.Popen(child_cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, env=env)
     relayed = [0, 0]  # [measurements, error records]
@@ -931,6 +1034,16 @@ def main(argv=None) -> int:
                    help="total steps of each --chaos run")
     p.add_argument("--chaos-fail-at", type=int, default=5,
                    help="step after which the faulted --chaos run crashes")
+    p.add_argument("--chaos-cold", action="store_true",
+                   help="--chaos: also run the faulted job with the compile "
+                        "cache disabled and report the cold-cache recovery "
+                        "overhead next to the warm one (roughly doubles the "
+                        "chaos runtime)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent compile cache + AOT step executables "
+                        "shared by parent/child/suite rows "
+                        "(docs/compile_cache.md); default $DDL_COMPILE_CACHE "
+                        "or <repo>/.cache/jax_compile; 'off' disables")
     p.add_argument("--run-child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -1022,6 +1135,8 @@ def main(argv=None) -> int:
         child_cmd += ["--optimizer-sharding", args.optimizer_sharding]
     if args.trace_dir:
         child_cmd += ["--trace-dir", args.trace_dir]
+    if args.compile_cache_dir is not None:
+        child_cmd += ["--compile-cache-dir", args.compile_cache_dir]
     if args.suite:
         child_cmd += ["--suite"]
         if args.suite_models:
@@ -1029,6 +1144,18 @@ def main(argv=None) -> int:
         if args.suite_rows:
             child_cmd += ["--suite-rows", args.suite_rows]
         args.attempt_timeout = max(args.attempt_timeout, args.budget)
+
+    # Export the shared cache once so every attempt's child (and anything
+    # it spawns) lands on the same directory — attempt 2 of a flaky tunnel
+    # then reuses attempt 1's compiled programs.
+    cache_dir = _compile_cache_dir(args.compile_cache_dir)
+    if cache_dir is not None:
+        os.makedirs(cache_dir, exist_ok=True)
+        os.environ["DDL_COMPILE_CACHE"] = cache_dir
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    else:
+        os.environ["DDL_COMPILE_CACHE"] = "off"
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
     last_err = "no attempt ran"
     deadline = time.monotonic() + args.budget

@@ -222,6 +222,13 @@ class TrainConfig:
                                   # volume as the ring all-reduce, optimizer
                                   # HBM / update FLOPs divided by the DP
                                   # degree (parallel/zero.py)
+    compile_cache_dir: Optional[str] = None  # persistent compile cache + AOT
+                                  # step executables (perf/compile_cache.py):
+                                  # None = $DDL_COMPILE_CACHE, else the
+                                  # repo-local .cache/jax_compile default;
+                                  # "off" disables. Volatile w.r.t. the
+                                  # config fingerprint — it never changes
+                                  # the compiled program
     # GPipe microbatch count for *_pp models (None = model default). The
     # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
     # under ~20% (tools/bench_parallel_overhead.py measures this).

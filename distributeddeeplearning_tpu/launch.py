@@ -397,6 +397,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--heartbeat-dir", default=None,
                    help="heartbeat file directory (default: a fresh temp "
                         "dir; local --num-processes jobs only)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent compile cache shared by every child and "
+                        "every restart attempt (docs/compile_cache.md); "
+                        "default $DDL_COMPILE_CACHE or the repo-local "
+                        ".cache/jax_compile; 'off' disables")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -406,6 +411,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         command = command[1:]
     if not command:
         p.error("no training command given (pass it after `--`)")
+
+    # One compile cache for the whole job: resolve launcher flag > training
+    # command's own --compile-cache-dir > env > default, then export it so
+    # EVERY child of EVERY restart attempt lands on the same cache — a
+    # restarted attempt then loads the previous attempt's executables
+    # instead of recompiling (perf/compile_cache.py; jax-free here).
+    from distributeddeeplearning_tpu.perf import compile_cache
+    cache_flag = (args.compile_cache_dir
+                  if args.compile_cache_dir is not None
+                  else _flag_from_command(command, "--compile-cache-dir"))
+    cache_dir = compile_cache.resolve_dir(cache_flag)
+    if cache_dir is not None:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError:
+            cache_dir = None
+    compile_cache.export_env(cache_dir)
 
     if args.hostfile:
         if args.process_id is None:

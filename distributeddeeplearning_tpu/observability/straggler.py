@@ -30,16 +30,30 @@ class StragglerMonitor:
         self.num_processes = num_processes
 
     def collect(self, step: int, step_time_s: float,
-                data_wait_s: float) -> dict:
+                data_wait_s: float,
+                compile_s: Optional[float] = None) -> dict:
         """Allgather this host's phase times; returns the skew fields to
-        fold into the chief's log record (identical on every process)."""
+        fold into the chief's log record (identical on every process).
+
+        ``compile_s`` is passed exactly once per run — on the first log
+        boundary after the step program is built (train/loop.py) — and
+        widens the payload on EVERY host at that step (the compile happens
+        at the same step everywhere, so the collective shapes agree). It
+        surfaces hosts that straggle in *compile* (cold cache on one host,
+        slow persistent-cache volume) the same way step-time skew is
+        surfaced.
+        """
         import jax
         import numpy as np
         from jax.experimental import multihost_utils
 
+        payload = [step_time_s, data_wait_s]
+        if compile_s is not None:
+            payload.append(compile_s)
+        width = len(payload)
         arr = multihost_utils.process_allgather(
-            np.asarray([step_time_s, data_wait_s], np.float64))
-        arr = np.asarray(arr).reshape(self.num_processes, 2)
+            np.asarray(payload, np.float64))
+        arr = np.asarray(arr).reshape(self.num_processes, width)
         st, dw = arr[:, 0], arr[:, 1]
         mean = float(st.mean())
         slowest = int(st.argmax())
@@ -50,6 +64,26 @@ class StragglerMonitor:
             "host_step_time_mean": round(mean, 6),
             "host_data_wait_max": round(float(dw.max()), 6),
         }
+        if compile_s is not None:
+            cp = arr[:, 2]
+            cmean = float(cp.mean())
+            record["host_compile_min"] = round(float(cp.min()), 6)
+            record["host_compile_max"] = round(float(cp.max()), 6)
+            record["host_compile_mean"] = round(cmean, 6)
+            # Compile skew matters above noise level only: sub-second
+            # "compiles" are warm AOT loads everywhere.
+            if cmean > 0.5 and float(cp.max()) > self.threshold * cmean:
+                slow_cp = int(cp.argmax())
+                record["compile_straggler_host"] = slow_cp
+                telemetry.get().instant(
+                    "compile_straggler", step=step, host=slow_cp,
+                    compile_s=round(float(cp.max()), 6),
+                    mean_s=round(cmean, 6))
+                if jax.process_index() == 0:
+                    print(f"# compile straggler: host {slow_cp} compiled in "
+                          f"{cp.max():.1f}s > {self.threshold:.2f}x mean "
+                          f"{cmean:.1f}s (cold cache on one host?)",
+                          file=sys.stderr, flush=True)
         if mean > 0 and float(st.max()) > self.threshold * mean:
             record["straggler_host"] = slowest
             telemetry.get().instant(

@@ -1,0 +1,17 @@
+"""Compilation-latency subsystem: persistent compile cache + AOT executables.
+
+Cold-start and recovery latency are dominated by XLA compilation we already
+paid for on a previous run (or a previous restart attempt). This package
+makes compilation a cached, observable resource:
+
+- ``compile_cache`` — one shared persistent-cache policy (directory layout,
+  env/flag plumbing, hit/miss counters) used by train.py, bench.py, and
+  launch.py, and inherited by every spawned child and restart attempt.
+- ``aot`` — ahead-of-time ``lower().compile()`` of the train/eval step
+  keyed by a stable config fingerprint, with serialized-executable
+  save/load so a warm restart skips tracing entirely.
+
+Both layers are strictly wall-clock optimizations: a cache hit loads the
+same XLA program a cold compile would produce, so numerics (including the
+zero1<->replicated and chaos-soak bitwise pins) are unaffected.
+"""

@@ -1,0 +1,245 @@
+"""Ahead-of-time step executables keyed by a stable config fingerprint.
+
+A restart attempt (launch.run_with_restarts) and a re-launch of the same
+config pay the largest fixed cost of the run again: tracing + XLA-compiling
+the train step. This module removes that cost end to end:
+
+- ``config_fingerprint`` hashes exactly the parts of a ``TrainConfig`` that
+  reach the compiled program (model, topology, parallel axes, dtypes,
+  optimizer/schedule inputs, jax/jaxlib versions) and *excludes* volatile
+  host-side knobs (trace dirs, checkpoint paths, log cadence, fault plans).
+  The one program-affecting piece of fault injection — compiled-in NaN-grad
+  injection and the bad-step guard — re-enters the hash via the *resolved*
+  plan for this restart attempt, so a recovery attempt whose injected fault
+  has expired fingerprints identically to a clean run and can reuse its
+  executable.
+- ``StepExecutableCache`` stores ``jax.experimental.serialize_executable``
+  payloads under ``<compile_cache>/aot/<key>.aotx``; a warm restart
+  deserializes the executable and skips tracing entirely. Any mismatch
+  (format, jax version, unreadable payload) is a silent miss that falls
+  back to a cold ``lower().compile()`` — never a failure.
+
+A cache hit loads byte-identical XLA output for the same program, so
+numerics are unchanged (the zero1<->replicated and chaos-soak bitwise pins
+hold with the cache hot or cold).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import sys
+import time
+from typing import Any, Optional
+
+from distributeddeeplearning_tpu.perf import compile_cache
+
+FORMAT_VERSION = 1
+
+# TrainConfig fields that never reach the compiled step program: paths,
+# cadences, watchdog thresholds, and host-side fault orchestration. The
+# nan-grad/guard portion of fault handling DOES reach the program and is
+# re-added as _fault_program below from the plan resolved for this attempt.
+VOLATILE_FIELDS = frozenset({
+    "log_every", "eval_every_epochs",
+    "checkpoint_dir", "checkpoint_every_steps", "resume",
+    "profile_steps", "profile_dir",
+    "trace_dir", "trace_steps", "trace_max_events",
+    "straggler_threshold", "bad_step_limit",
+    "fault_plan", "fail_at_step",
+    "compile_cache_dir",
+})
+
+# Same for DataConfig: host-pipeline knobs that leave batch shapes alone.
+VOLATILE_DATA_FIELDS = frozenset({
+    "data_dir", "loader", "shuffle_buffer", "prefetch_depth",
+    "loader_timeout_s", "loader_retries",
+})
+
+
+def _versions() -> dict[str, str]:
+    import jax
+    import jaxlib
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+def config_fingerprint(config, *, total_steps: Optional[int] = None,
+                       extra: Any = None) -> str:
+    """Stable hash of everything about ``config`` that shapes the compiled
+    step program. Equal configs -> equal keys; volatile fields (trace dirs,
+    checkpoint paths, host-side fault plans, cadences) never perturb it;
+    a jax/jaxlib upgrade always does.
+
+    ``total_steps`` must be passed when known: the LR schedule bakes it
+    into the update computation (train/optim.py), so two runs differing
+    only in horizon compile different programs.
+    """
+    d = dataclasses.asdict(config)
+    for field in VOLATILE_FIELDS:
+        d.pop(field, None)
+    if isinstance(d.get("data"), dict):
+        for field in VOLATILE_DATA_FIELDS:
+            d["data"].pop(field, None)
+    # Resolved per-attempt fault program: nan-grad injection steps and the
+    # bad-step guard are compiled into the step (train/steps._guard_config).
+    from distributeddeeplearning_tpu.robustness import faults
+    nan_steps = faults.resolve(config).nan_grad_steps()
+    d["_fault_program"] = {
+        "nan_steps": sorted(nan_steps),
+        "guard": bool(nan_steps) or bool(getattr(config, "bad_step_guard",
+                                                 False)),
+    }
+    d["_total_steps"] = total_steps
+    d["_versions"] = _versions()
+    if extra is not None:
+        d["_extra"] = extra
+    blob = json.dumps(d, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def runtime_tag() -> str:
+    """Device-topology component of executable keys: an executable compiled
+    for one platform/chip/mesh size never deserializes onto another."""
+    import jax
+    devices = jax.devices()
+    dev = devices[0]
+    return (f"{dev.platform}:{getattr(dev, 'device_kind', '?')}:"
+            f"{len(devices)}x{jax.process_count()}")
+
+
+def _aval_signature(args) -> list:
+    """Tree structure + per-leaf (shape, dtype) of the call arguments."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return [str(treedef),
+            [(tuple(getattr(x, "shape", ())),
+              str(getattr(x, "dtype", type(x).__name__))) for x in leaves]]
+
+
+class StepExecutableCache:
+    """Fingerprint-keyed store of serialized step executables.
+
+    One instance per run (train/loop.build creates it); disabled entirely
+    when the compile cache is off (``cache_dir=None``). All methods are
+    best-effort: a broken entry is a miss, a failed save is a warning.
+    """
+
+    def __init__(self, cache_dir: Optional[str], fingerprint: str):
+        self.cache_dir = cache_dir
+        self.dir = (os.path.join(cache_dir, compile_cache.AOT_SUBDIR)
+                    if cache_dir else None)
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.misses = 0
+        self.failures = 0
+        self.saves = 0
+        self.sources: dict[str, str] = {}  # step name -> aot_hit | compiled
+
+    @classmethod
+    def for_config(cls, config, *, total_steps: Optional[int] = None,
+                   cache_dir: Optional[str] = None) -> "StepExecutableCache":
+        explicit = (cache_dir if cache_dir is not None
+                    else getattr(config, "compile_cache_dir", None))
+        resolved = compile_cache.resolve_dir(explicit)
+        return cls(resolved, config_fingerprint(config,
+                                                total_steps=total_steps))
+
+    @property
+    def enabled(self) -> bool:
+        return self.dir is not None
+
+    def key(self, name: str, args) -> str:
+        blob = json.dumps(
+            [self.fingerprint, name, runtime_tag(), _aval_signature(args)],
+            sort_keys=True, default=repr)
+        return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.aotx")
+
+    def load(self, name: str, key: str):
+        """Deserialize the cached executable for ``key``; None on miss or
+        on ANY mismatch (format, jax version, corrupt payload) — the caller
+        cold-compiles and overwrites the entry."""
+        if self.dir is None:
+            return None
+        path = self._path(key)
+        if not os.path.exists(path):
+            self.misses += 1
+            self.sources[name] = "compiled"
+            return None
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+            if payload.get("format") != FORMAT_VERSION:
+                raise ValueError(f"format {payload.get('format')!r}")
+            if payload.get("versions") != _versions():
+                raise ValueError(
+                    f"built under jax {payload.get('versions')}, "
+                    f"running {_versions()}")
+            from jax.experimental import serialize_executable
+            fn = serialize_executable.deserialize_and_load(
+                payload["executable"], payload["in_tree"],
+                payload["out_tree"])
+        except Exception as exc:  # noqa: BLE001 - any mismatch = cold path
+            self.failures += 1
+            self.misses += 1
+            self.sources[name] = "compiled"
+            print(f"[aot] cached executable for {name} unusable "
+                  f"({type(exc).__name__}: {exc}); recompiling cold",
+                  file=sys.stderr)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        self.hits += 1
+        self.sources[name] = "aot_hit"
+        return fn
+
+    def save(self, name: str, key: str, compiled_exec) -> bool:
+        """Serialize ``compiled_exec`` under ``key`` (atomic write; every
+        process writes identical bytes, last rename wins)."""
+        if self.dir is None:
+            return False
+        try:
+            from jax.experimental import serialize_executable
+            executable, in_tree, out_tree = serialize_executable.serialize(
+                compiled_exec)
+            blob = pickle.dumps({
+                "format": FORMAT_VERSION,
+                "versions": _versions(),
+                "runtime": runtime_tag(),
+                "name": name,
+                "fingerprint": self.fingerprint,
+                "executable": executable,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "saved_at": time.time(),
+            })
+            os.makedirs(self.dir, exist_ok=True)
+            path = self._path(key)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)
+        except Exception as exc:  # noqa: BLE001 - saving is optional
+            print(f"[aot] could not serialize {name} "
+                  f"({type(exc).__name__}: {exc}); run continues uncached",
+                  file=sys.stderr)
+            return False
+        self.saves += 1
+        return True
+
+    def stats(self) -> dict[str, Any]:
+        return {"aot_hits": self.hits, "aot_misses": self.misses,
+                "aot_failures": self.failures, "aot_saves": self.saves,
+                "fingerprint": self.fingerprint,
+                "sources": dict(self.sources)}
+
+    def flush_stats(self) -> None:
+        """Persist counters next to the cache for tools/doctor.py."""
+        compile_cache.write_stats(self.cache_dir, self.stats())

@@ -33,6 +33,17 @@ def _abstract_like(state: Any) -> Any:
         state)
 
 
+def device_copy(state: Any) -> Any:
+    """Device-side copy of every array leaf: same sharding, NEW buffers,
+    bitwise-identical contents (``jnp.copy`` — no arithmetic, so even
+    ``-0.0`` signs survive). NOT ``device_put(x, x.sharding)``, which
+    short-circuits to an alias of the same buffers and protects nothing."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state)
+
+
 class _CorruptCheckpoint(Exception):
     """A step that orbax could not read back — corrupt or partially written.
 

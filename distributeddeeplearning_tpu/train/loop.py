@@ -28,6 +28,8 @@ from distributeddeeplearning_tpu.observability import straggler as stragglib
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero as zerolib
+from distributeddeeplearning_tpu.perf import aot as aotlib
+from distributeddeeplearning_tpu.perf import compile_cache as cachelib
 from distributeddeeplearning_tpu.robustness import faults as faultslib
 from distributeddeeplearning_tpu.train import checkpoint as ckptlib
 from distributeddeeplearning_tpu.train import optim, steps
@@ -259,10 +261,17 @@ def build(config: TrainConfig, total_steps: int):
         else:
             out_shd = replicated
         state = jax.jit(init_fn, out_shardings=out_shd)(rng)
+        # AOT executable cache (perf/aot.py): keyed by the config
+        # fingerprint + total_steps (the LR schedule bakes the horizon into
+        # the program), so a restart attempt or re-launch of the same config
+        # deserializes the step instead of retracing it.
+        aot = aotlib.StepExecutableCache.for_config(
+            config, total_steps=total_steps)
         train_step = steps.make_dp_train_step(
             model, tx, mesh, config, spec.input_kind, spec.objective,
-            state_like=state)
+            state_like=state, aot=aot)
         train_step.zero_converter = converter
+        train_step.aot = aot
 
     return mesh, model, batch_shd, state, train_step, sched, rng
 
@@ -284,6 +293,7 @@ def run(config: TrainConfig, *, total_steps: int,
     (SURVEY.md §3.5): sharded top-1 for image models, mean per-token loss
     (perplexity) for token models.
     """
+    t_origin = time.perf_counter()  # time_to_first_step_s measures from here
     owns_logger = logger is None
     logger = logger or MetricLogger()
     # A caller-reused logger (in-process restart harnesses) must not turn
@@ -298,6 +308,10 @@ def run(config: TrainConfig, *, total_steps: int,
         trace_dir=config.trace_dir, trace_steps=config.trace_steps,
         max_events=config.trace_max_events,
         process_index=jax.process_index())
+    # Persistent compile cache (perf/compile_cache.py): pointed at the
+    # shared directory BEFORE any compile, and re-exported through the
+    # environment so launcher children and restart attempts inherit it.
+    cachelib.activate(getattr(config, "compile_cache_dir", None))
     spec = model_spec(config.model)
     mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
@@ -309,7 +323,8 @@ def run(config: TrainConfig, *, total_steps: int,
             config, spec, mesh, model, batch_shd, state, train_step, sched,
             rng, ckpt, logger, total_steps=total_steps,
             warmup_steps=warmup_steps, eval_batches=eval_batches,
-            return_state=return_state, restore_for_eval=restore_for_eval)
+            return_state=return_state, restore_for_eval=restore_for_eval,
+            t_origin=t_origin)
     finally:
         if ckpt is not None:
             ckpt.close()  # releases the async-checkpointing executor
@@ -323,7 +338,10 @@ def run(config: TrainConfig, *, total_steps: int,
 
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
-               return_state, restore_for_eval=False) -> dict[str, Any]:
+               return_state, restore_for_eval=False,
+               t_origin=None) -> dict[str, Any]:
+    if t_origin is None:
+        t_origin = time.perf_counter()
     # Fault plan (robustness/faults.py): config.fault_plan + the per-child
     # DDL_FAULT_PLAN env + the legacy fail_at_step shim, filtered to this
     # restart attempt. Empty plan (the default) => injector is None and the
@@ -352,6 +370,17 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     else ckpt.restore_latest(state))
         if restored is not None:
             state = restored
+            _aot = getattr(train_step, "aot", None)
+            if _aot is not None and _aot.enabled:
+                # Warm-restart donation safety: on CPU, orbax-restored
+                # arrays can ALIAS host memory the restore machinery owns
+                # (zero-copy device_put). jit refuses to donate such
+                # buffers; a directly-called AOT executable (perf/aot.py)
+                # donates unconditionally — glibc heap corruption
+                # (SIGSEGV/SIGABRT) a few steps into every warm restart.
+                # One bitwise-identical device copy breaks the alias so the
+                # donated buffers are XLA-owned, like a fresh init's.
+                state = ckptlib.device_copy(state)
             start_step = int(jax.device_get(state.step))
     # Source is created here — after restore — so a real (streaming) pipeline
     # starts at the resume step rather than replaying from zero. A run with
@@ -395,6 +424,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             spe = steps_per_epoch(config)
             if spe is not None:
                 eval_every_steps = max(int(config.eval_every_epochs * spe), 1)
+        if start_step < total_steps:
+            # Overlap: warm-compile the eval step on a background thread
+            # while the first training steps run, so the first
+            # epoch-boundary eval doesn't stall the loop on a cold compile.
+            evaluator.warm_compile_async(
+                state, aot=getattr(train_step, "aot", None))
 
     # Fused multi-step blocks (config.steps_per_loop > 1): only when batches
     # are generated on-device (synthetic sources expose gen_fn) — a streaming
@@ -474,6 +509,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         heartbeat.beat(start_step)  # arm the watchdog before compile
     # warmup_steps == 0 means "time everything" (incl. compile).
     t_timed = time.perf_counter() if warmup_steps == 0 else None
+    # Cold-start measurement (docs/compile_cache.md): the first dispatch's
+    # host-blocking wall time is the trace+compile (or AOT load) cost;
+    # time_to_first_step_s is run() entry -> first step's results fetched.
+    compile_time_s: Optional[float] = None
+    time_to_first_step_s: Optional[float] = None
+    compile_pending: Optional[float] = None
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
@@ -488,6 +529,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             n = (min(config.steps_per_loop, _next_boundary(i) - i)
                  if fused_runner is not None else 1)
             profile.before_step(i)
+            t_step0 = (time.perf_counter() if compile_time_s is None
+                       else None)
             if n == 1:
                 if phase_clock:
                     t0 = telemetry.now_s()
@@ -509,6 +552,20 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 else:
                     state, metrics = fused_runner(state, rng, i, n)
             i += n
+            if t_step0 is not None:
+                # First step of this run. The dispatch above blocked the
+                # host for the trace+compile (or AOT load); the fetch below
+                # is a true execution barrier, so the pair gives cold-start
+                # latency. One extra sync on step one only — numerics and
+                # steady-state timing are untouched.
+                compile_time_s = time.perf_counter() - t_step0
+                jax.device_get(metrics)
+                time_to_first_step_s = time.perf_counter() - t_origin
+                compile_pending = compile_time_s
+                tele.gauge("compile_time_s", round(compile_time_s, 3),
+                           step=int(i))
+                tele.gauge("time_to_first_step_s",
+                           round(time_to_first_step_s, 3), step=int(i))
             profile.after_step(i - 1, metrics)
             bad_tracker.push(metrics)
             done = i - start_step
@@ -526,9 +583,18 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 if straggler is not None:
                     # One small allgather per log step, on EVERY process at
                     # the same step — a collective, like the eval syncs.
+                    # compile_s rides along exactly once (the first log
+                    # after the program was built — the same step on every
+                    # host), surfacing compile stragglers.
                     extra = straggler.collect(
                         int(i), (t_log - t_last_log) / interval_steps,
-                        data_wait_acc / interval_steps)
+                        data_wait_acc / interval_steps,
+                        compile_s=compile_pending)
+                if compile_pending is not None:
+                    extra["compile_time_s"] = round(compile_pending, 3)
+                    extra["time_to_first_step_s"] = round(
+                        time_to_first_step_s, 3)
+                    compile_pending = None
                 # logger floats every metric (a true fetch barrier); no
                 # separate block needed. Its span is therefore the device
                 # time of the steps still in flight — log-cadence only, so
@@ -600,6 +666,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         "final_metrics": {k: float(v) for k, v in metrics.items()},
         "bad_steps": bad_tracker.total,
     }
+    if compile_time_s is not None:
+        summary["compile_time_s"] = round(compile_time_s, 3)
+        summary["time_to_first_step_s"] = round(time_to_first_step_s, 3)
+    aot = getattr(train_step, "aot", None)
+    if aot is not None and aot.enabled:
+        summary["compile_cache"] = aot.stats()
+        aot.flush_stats()  # counters land next to the cache for doctor.py
     hbm = _device_memory_stats(state)
     if hbm:
         summary["memory"] = hbm
@@ -801,6 +874,67 @@ class _EvaluatorBase:
             datalib.make_source(config, self.input_kind, batch_shd,
                                 objective=self.objective)
             if self.synthetic else None)
+        self._warm_thread: Optional[threading.Thread] = None
+        self._warm_exec = None
+
+    def warm_compile_async(self, state, aot=None) -> None:
+        """Compile the eval step on a background thread while the first
+        training steps run (overlap — the loop's hot path never blocks on
+        this). The executable is built ahead-of-time from abstract avals
+        (``lower().compile()``): the live ``state`` buffers are donated by
+        the next train step, so only their ShapeDtypeStructs are captured.
+        The first eval joins the thread and calls the prepared executable;
+        any failure here silently leaves the cold path in place.
+
+        ``aot`` (perf/aot.StepExecutableCache) additionally persists the
+        executable, so the next launch of this config skips even the
+        overlapped compile.
+        """
+        if self._warm_thread is not None or self._warm_exec is not None:
+            return
+        if state.ema_params is not None:
+            state = state.replace(params=state.ema_params)
+        state_struct = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), state)
+        tele = telemetry.get()
+
+        def work():
+            try:
+                t0 = telemetry.now_s()
+                # One throwaway batch fixes the eval batch avals; synthetic
+                # sources are indexable (nothing is consumed) and real
+                # sources are rebuilt fresh per eval invocation anyway.
+                source, offset = self._source_and_offset()
+                batch = source.batch(offset)
+                fn = None
+                key = None
+                if aot is not None and aot.enabled:
+                    key = aot.key("eval_step", (state_struct, batch))
+                    fn = aot.load("eval_step", key)
+                if fn is None:
+                    lower = getattr(self.eval_step, "lower_for",
+                                    None) or self.eval_step.lower
+                    fn = lower(state_struct, batch).compile()
+                    if key is not None:
+                        aot.save("eval_step", key, fn)
+                self._warm_exec = fn
+                tele.record_span("warm_compile", t0, telemetry.now_s())
+            except Exception:  # noqa: BLE001 - warm-up is optional
+                self._warm_exec = None
+
+        self._warm_thread = threading.Thread(
+            target=work, daemon=True, name="ddl-eval-warm-compile")
+        self._warm_thread.start()
+
+    def _eval_fn(self):
+        """The step callable for this invocation: the warm-compiled
+        executable when the overlap produced one, else the cold jit."""
+        if self._warm_thread is not None:
+            self._warm_thread.join()
+            self._warm_thread = None
+        return self._warm_exec if self._warm_exec is not None \
+            else self.eval_step
 
     def _source_and_offset(self):
         if self.synthetic:
@@ -852,6 +986,7 @@ class _EvaluatorBase:
                         f"{self._config.global_batch_size}); shrink the "
                         f"batch or provide more validation images")
         outs = []
+        eval_fn = self._eval_fn()
         for j in range(num_batches):
             try:
                 batch = source.batch(offset + j)
@@ -892,7 +1027,18 @@ class _EvaluatorBase:
                     f"{self.num_batches} eval batches; scoring the "
                     f"available ones")
                 break
-            outs.append(jax.device_get(self.eval_step(state, batch)))
+            try:
+                outs.append(jax.device_get(eval_fn(state, batch)))
+            except Exception:  # noqa: BLE001
+                if eval_fn is self.eval_step:
+                    raise
+                # The warm executable's avals disagree with the live batch
+                # (e.g. a real loader emitted a different structure than
+                # the warm-up batch). Eval steps don't donate, so retrying
+                # through the cold jit is safe.
+                eval_fn = self.eval_step
+                self._warm_exec = None
+                outs.append(jax.device_get(eval_fn(state, batch)))
         return self._accumulate(outs)
 
 

@@ -24,7 +24,9 @@ Both donate the input state (in-place update in HBM, no copy).
 
 from __future__ import annotations
 
+import collections
 import functools
+import time
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -39,11 +41,42 @@ from distributeddeeplearning_tpu.parallel import collectives
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero
 from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+from distributeddeeplearning_tpu.observability import telemetry
 from distributeddeeplearning_tpu.robustness import faults
 from distributeddeeplearning_tpu.train import losses
 from distributeddeeplearning_tpu.train.state import TrainState
 
 DATA_AXES = ("data", "fsdp")
+
+# Trace-time counters, keyed by step name. A step function's Python body
+# runs only while jax is TRACING it, so each counter increments exactly once
+# per (re)trace — the probe tests use to assert that a warm restart loads
+# its executable from the AOT cache without tracing at all.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def _aot_acquire(aot, name: str, jitted, args):
+    """Resolve an ahead-of-time executable for ``jitted`` at ``args``' avals.
+
+    Fingerprint hit: deserialize the saved executable (telemetry span
+    ``aot_load``) — zero tracing. Miss: ``lower().compile()`` cold
+    (telemetry span ``compile``) and serialize for the next attempt. The
+    lowered ``Compiled`` object must be called directly — invoking the jit
+    wrapper afterwards would re-trace, since AOT compilation bypasses jit's
+    internal cache.
+    """
+    tele = telemetry.get()
+    key = aot.key(name, args)
+    t0 = time.perf_counter()
+    fn = aot.load(name, key)
+    if fn is not None:
+        tele.record_span("aot_load", t0, time.perf_counter())
+        return fn
+    t0 = time.perf_counter()
+    compiled_exec = jitted.lower(*args).compile()
+    tele.record_span("compile", t0, time.perf_counter())
+    aot.save(name, key, compiled_exec)
+    return compiled_exec
 
 
 def _inject_nan_grads(grads, step, nan_steps):
@@ -240,7 +273,8 @@ def accumulated_grads(loss_fn, params, batch_stats, batch, rng, accum: int,
 def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        config: TrainConfig, input_kind: str = "image",
                        objective: str = "classify",
-                       state_like: Optional[TrainState] = None
+                       state_like: Optional[TrainState] = None,
+                       aot=None
                        ) -> Callable[[TrainState, Any, jax.Array],
                                      tuple[TrainState, dict]]:
     """Build the jitted data-parallel train step.
@@ -261,6 +295,11 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     HBM/compute divided by the DP degree. ``state_like`` (the initialized
     TrainState, chunked opt state included) is required then: it supplies
     the per-leaf partition specs for shard_map.
+
+    ``aot`` (a perf.aot.StepExecutableCache) switches the first call to the
+    ahead-of-time path: load the serialized executable for this config
+    fingerprint, or ``lower().compile()`` once and serialize it so the next
+    launch / restart attempt skips tracing entirely (docs/compile_cache.md).
     """
     loss_fn = loss_fn_for(model, input_kind, config, objective)
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
@@ -282,6 +321,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             params_struct, dp_size, options=config.allreduce)
 
     def step_fn(state: TrainState, batch, rng):
+        TRACE_COUNTS["dp_train_step"] += 1  # trace-time only, not per call
         # Per-shard RNG: fold in the linearized DP coordinate.
         idx = jax.lax.axis_index(DATA_AXES)
         rng = jax.random.fold_in(jax.random.fold_in(rng, idx), state.step)
@@ -376,8 +416,18 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         in_specs=(state_spec, batch_spec, P()),
         out_specs=(state_spec, P()))
     jitted = jax.jit(mapped, donate_argnums=0)
+    aot_exec = {"fn": None, "resolved": aot is None or not aot.enabled}
 
     def compiled(state, batch, rng):
+        if not aot_exec["resolved"]:
+            # First call: bind the AOT executable at these argument avals.
+            # Donation (argnums=0) is baked into the lowering, so the
+            # Compiled object updates state in place exactly like the jit.
+            aot_exec["resolved"] = True
+            aot_exec["fn"] = _aot_acquire(aot, "dp_train_step", jitted,
+                                          (state, batch, rng))
+        if aot_exec["fn"] is not None:
+            return aot_exec["fn"](state, batch, rng)
         return jitted(state, batch, rng)
 
     # Raw traceable step for the fused multi-step loop
@@ -395,6 +445,7 @@ def make_token_eval_step(model, mesh: Mesh, config: TrainConfig,
     path's psum'd correct-counts, SURVEY.md §3.5)."""
 
     def eval_fn(state: TrainState, batch):
+        TRACE_COUNTS["token_eval_step"] += 1
         kw = {}
         if objective != "causal" and "masked_positions" in batch:
             kw["masked_positions"] = batch["masked_positions"]
@@ -422,6 +473,21 @@ def make_token_eval_step(model, mesh: Mesh, config: TrainConfig,
         with use_mesh(mesh):
             return jit_cache[key](state, batch)
 
+    def lower_for(state, batch):
+        """AOT entry for the eval warm-compile overlap (train/loop.py):
+        lower at abstract avals without executing. The caller keeps the
+        returned Lowered's ``compile()`` result and must call IT — jit's
+        internal cache is not populated by AOT compilation."""
+        key = jax.tree_util.tree_structure(batch)
+        if key not in jit_cache:
+            jit_cache[key] = jax.jit(
+                eval_fn,
+                in_shardings=(state_shardings, None),
+                out_shardings=NamedSharding(mesh, P()))
+        with use_mesh(mesh):
+            return jit_cache[key].lower(state, batch)
+
+    compiled.lower_for = lower_for
     return compiled
 
 
@@ -430,6 +496,7 @@ def make_dp_eval_step(model, mesh: Mesh, config: TrainConfig):
     del config
 
     def eval_fn(state: TrainState, batch):
+        TRACE_COUNTS["dp_eval_step"] += 1
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
@@ -508,6 +575,7 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
     batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
 
     def step_fn(state: TrainState, batch, rng):
+        TRACE_COUNTS["gspmd_train_step"] += 1
         rng = jax.random.fold_in(rng, state.step)
         with _unreplicated_rules_ctx(config):
             # Microbatching under GSPMD: the (B,) -> (A, B/A) reshape crosses

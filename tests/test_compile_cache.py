@@ -1,0 +1,341 @@
+"""Persistent compile cache + AOT step executables (docs/compile_cache.md).
+
+Three layers under test:
+
+- policy (perf/compile_cache.py): flag > env > default resolution, off
+  switch, stats sidecar, age-based prune;
+- fingerprint (perf/aot.py): equal configs -> equal keys, volatile host
+  knobs never perturb the key, program-shaping fields and jax upgrades
+  always do, and attempt-scoped faults expire out of the hash;
+- warm restart: a second attempt through ``launch.run_with_restarts``
+  loads the serialized executable and performs ZERO retraces of the train
+  step (probed via ``steps.TRACE_COUNTS``), end-to-end through
+  ``loop.run`` with the summary/logger cold-start fields.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributeddeeplearning_tpu import launch
+from distributeddeeplearning_tpu.config import (
+    DataConfig, OptimizerConfig, ParallelConfig, TrainConfig)
+from distributeddeeplearning_tpu.perf import aot, compile_cache
+from distributeddeeplearning_tpu.robustness import faults
+
+
+def _cfg(**kw):
+    base = dict(
+        model="resnet18_thin", global_batch_size=16, dtype="float32",
+        log_every=10**9,
+        parallel=ParallelConfig(data=8),
+        data=DataConfig(synthetic=True, image_size=8, num_classes=10),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1,
+                                  reference_batch=16, momentum=0.9,
+                                  schedule="constant", warmup_epochs=0.0))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Cache-dir policy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_resolve_dir_precedence(monkeypatch, tmp_path):
+    monkeypatch.delenv(compile_cache.ENV_CACHE, raising=False)
+    assert compile_cache.resolve_dir() == compile_cache.default_dir()
+    monkeypatch.setenv(compile_cache.ENV_CACHE, str(tmp_path / "env"))
+    assert compile_cache.resolve_dir() == str(tmp_path / "env")
+    # explicit flag beats env
+    assert compile_cache.resolve_dir(str(tmp_path / "flag")) == \
+        str(tmp_path / "flag")
+    # any off-spelling disables, at either level
+    for off in ("off", "none", "0", "disabled", "OFF"):
+        assert compile_cache.resolve_dir(off) is None
+    monkeypatch.setenv(compile_cache.ENV_CACHE, "off")
+    assert compile_cache.resolve_dir() is None
+
+
+@pytest.mark.core
+def test_export_env_roundtrip(monkeypatch, tmp_path):
+    monkeypatch.delenv(compile_cache.ENV_CACHE, raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    compile_cache.export_env(str(tmp_path))
+    assert os.environ[compile_cache.ENV_CACHE] == str(tmp_path)
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path)
+    compile_cache.export_env(None)  # disable propagates to children too
+    assert os.environ[compile_cache.ENV_CACHE] == "off"
+    assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+    assert compile_cache.resolve_dir() is None
+
+
+@pytest.mark.core
+def test_stats_sidecar_and_prune(tmp_path):
+    cache = str(tmp_path)
+    compile_cache.write_stats(cache, {"aot_hits": 3, "aot_misses": 1})
+    stats = compile_cache.read_stats(cache)
+    assert stats["aot_hits"] == 3 and "updated_at" in stats
+
+    old = tmp_path / "stale.bin"
+    new = tmp_path / "aot" / "fresh.aotx"
+    new.parent.mkdir()
+    old.write_bytes(b"x" * 10)
+    new.write_bytes(b"y" * 20)
+    past = time.time() - 40 * 86400
+    os.utime(old, (past, past))
+    removed, kept = compile_cache.prune(cache, max_age_days=30.0)
+    assert (removed, kept) == (1, 1)
+    assert not old.exists() and new.exists()
+    # the stats sidecar is bookkeeping, never a prunable entry
+    assert compile_cache.read_stats(cache)["aot_hits"] == 3
+    info = compile_cache.summarize(cache)
+    assert info["entries"] == 0 and info["aot_entries"] == 1
+    assert info["total_bytes"] == 20
+
+
+# ---------------------------------------------------------------------------
+# Config fingerprint stability
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_equal_configs_equal_keys(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    a = aot.config_fingerprint(_cfg(), total_steps=10)
+    b = aot.config_fingerprint(_cfg(), total_steps=10)
+    assert a == b
+
+
+@pytest.mark.core
+def test_volatile_fields_do_not_change_key(monkeypatch, tmp_path):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    base = aot.config_fingerprint(_cfg(), total_steps=10)
+    for kw in (dict(trace_dir=str(tmp_path / "tr")),
+               dict(checkpoint_dir=str(tmp_path / "ck"),
+                    checkpoint_every_steps=2),
+               dict(log_every=1),
+               dict(straggler_threshold=9.9),
+               dict(compile_cache_dir=str(tmp_path / "cc")),
+               # host-side process faults (crash/sigterm) never reach the
+               # compiled program — only nan_grads does (tested below)
+               dict(fault_plan="crash@3,sigterm@5")):
+        assert aot.config_fingerprint(_cfg(**kw), total_steps=10) == base, kw
+    # host data-pipeline knobs leave batch shapes alone
+    wide = _cfg(data=DataConfig(synthetic=True, image_size=8, num_classes=10,
+                                prefetch_depth=7))
+    assert aot.config_fingerprint(wide, total_steps=10) == base
+
+
+@pytest.mark.core
+def test_program_shaping_fields_change_key(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    base = aot.config_fingerprint(_cfg(), total_steps=10)
+    assert aot.config_fingerprint(_cfg(model="resnet18"),
+                                  total_steps=10) != base
+    assert aot.config_fingerprint(_cfg(global_batch_size=32),
+                                  total_steps=10) != base
+    assert aot.config_fingerprint(_cfg(dtype="bfloat16"),
+                                  total_steps=10) != base
+    # the LR schedule bakes the horizon into the update computation
+    assert aot.config_fingerprint(_cfg(), total_steps=20) != base
+
+
+@pytest.mark.core
+def test_nan_grad_plan_shapes_program_but_expires_per_attempt(monkeypatch):
+    """nan_grads compiles injection ops + the bad-step guard into the step,
+    so it must change the key — but only on the attempt it fires on. The
+    default scope is attempt 0, so the restart attempt's fingerprint equals
+    a clean run's and reuses its executable (the warm-restart fast path)."""
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    clean = aot.config_fingerprint(_cfg(), total_steps=10)
+    faulted = _cfg(fault_plan="nan_grads@3")
+    assert aot.config_fingerprint(faulted, total_steps=10) != clean
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "1")  # fault expired
+    assert aot.config_fingerprint(faulted, total_steps=10) == clean
+
+
+@pytest.mark.core
+def test_jax_version_changes_key(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    base = aot.config_fingerprint(_cfg(), total_steps=10)
+    monkeypatch.setattr(jax, "__version__", "99.0.0")
+    assert aot.config_fingerprint(_cfg(), total_steps=10) != base
+
+
+# ---------------------------------------------------------------------------
+# Warm restart: zero retraces through run_with_restarts
+# ---------------------------------------------------------------------------
+
+class _TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(10)(nn.relu(nn.Dense(16)(x)))
+
+
+@pytest.mark.usefixtures("devices8")
+@pytest.mark.core
+def test_restart_attempt_hits_aot_cache_zero_retraces(tmp_path, monkeypatch):
+    """Attempt 0 cold-compiles the DP train step and serializes it; the
+    restarted attempt (same config, fresh jit function) must load that
+    executable without tracing at all — the TRACE_COUNTS probe increments
+    only while jax runs the step's Python body, i.e. per (re)trace."""
+    from distributeddeeplearning_tpu.parallel import mesh as meshlib
+    from distributeddeeplearning_tpu.train import optim, steps
+    from distributeddeeplearning_tpu.train.state import TrainState
+
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    cfg = _cfg()
+    cache = str(tmp_path / "cache")
+    batch = {
+        "image": jax.random.normal(jax.random.key(2), (16, 8, 8, 3)),
+        "label": jax.random.randint(jax.random.key(3), (16,), 0, 10),
+    }
+    rng = jax.random.key(1)
+    traces, sources = [], []
+
+    def run_once():
+        # Fresh build per attempt, exactly like a relaunched process: new
+        # jit function, new cache handle — only the disk entry is shared.
+        cache_handle = aot.StepExecutableCache.for_config(
+            cfg, total_steps=4, cache_dir=cache)
+        mesh = meshlib.make_mesh(cfg.parallel)
+        model = _TinyNet()
+        tx, _ = optim.make_optimizer(cfg.optimizer, cfg.global_batch_size,
+                                     4, None)
+        variables = model.init({"params": jax.random.key(0)},
+                               jnp.zeros((1, 8, 8, 3)), train=False)
+        state = TrainState.create(params=variables["params"],
+                                  opt_state=tx.init(variables["params"]),
+                                  batch_stats=None)
+        step = steps.make_dp_train_step(model, tx, mesh, cfg,
+                                        aot=cache_handle)
+        before = steps.TRACE_COUNTS["dp_train_step"]
+        _, metrics = step(state, batch, rng)
+        jax.device_get(metrics)  # execution barrier
+        traces.append(steps.TRACE_COUNTS["dp_train_step"] - before)
+        sources.append(cache_handle.sources["dp_train_step"])
+        cache_handle.flush_stats()
+        return 1 if len(traces) == 1 else 0  # attempt 0 "crashes"
+
+    rc = launch.run_with_restarts(run_once, 1, sleep=lambda s: None)
+    assert rc == 0
+    assert traces == [1, 0]  # cold trace once, warm restart retraces NEVER
+    assert sources == ["compiled", "aot_hit"]
+    # the stats sidecar (last writer = the warm attempt) records the hit
+    stats = compile_cache.read_stats(cache)
+    assert stats["aot_hits"] == 1 and stats["aot_saves"] == 0
+
+
+@pytest.mark.usefixtures("devices8")
+def test_loop_warm_start_summary_and_zero_retrace(tmp_path, monkeypatch):
+    """End-to-end through loop.run: run 1 cold-compiles (summary +
+    MetricLogger carry compile_time_s / time_to_first_step_s, the AOT
+    entry is saved, the eval step warm-compiles on a thread); run 2 of the
+    identical config loads the executable — zero retraces of the train
+    step and sources=aot_hit in the summary."""
+    from distributeddeeplearning_tpu.train import loop, steps
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    cache = str(tmp_path / "cache")
+    # Set through monkeypatch so loop.run's export_env mutations of these
+    # keys are rolled back at teardown.
+    monkeypatch.setenv(compile_cache.ENV_CACHE, cache)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", cache)
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    cfg = _cfg(log_every=1, compile_cache_dir=cache)
+    try:
+        stream = io.StringIO()
+        s1 = loop.run(cfg, total_steps=2, eval_batches=1,
+                      logger=MetricLogger(stream=stream, enabled=True))
+        assert s1["compile_time_s"] > 0
+        assert s1["time_to_first_step_s"] >= s1["compile_time_s"]
+        cc = s1["compile_cache"]
+        assert cc["sources"]["dp_train_step"] == "compiled"
+        assert cc["aot_saves"] >= 1
+        first = json.loads(stream.getvalue().splitlines()[0])
+        assert first["compile_time_s"] > 0
+        assert first["time_to_first_step_s"] > 0
+
+        before = steps.TRACE_COUNTS["dp_train_step"]
+        s2 = loop.run(cfg, total_steps=2, eval_batches=1,
+                      logger=MetricLogger(enabled=False))
+        assert steps.TRACE_COUNTS["dp_train_step"] == before  # ZERO retraces
+        assert s2["compile_cache"]["sources"]["dp_train_step"] == "aot_hit"
+        assert s2["compile_cache"]["aot_hits"] >= 1
+        assert s2["compile_time_s"] < s1["compile_time_s"]
+        # both runs trained the same program: identical final loss
+        assert s1["final_metrics"]["loss"] == s2["final_metrics"]["loss"]
+    finally:
+        # loop.run pointed the process-global jax persistent cache at the
+        # tmp dir; re-point it at the repo default for the rest of the suite.
+        jax.config.update("jax_compilation_cache_dir",
+                          compile_cache.default_dir())
+
+
+@pytest.mark.usefixtures("devices8")
+def test_warm_resume_with_checkpointing_is_donation_safe(tmp_path, monkeypatch):
+    """The warm-RESTART path with checkpointing live — the one combination
+    that corrupted the heap before loop.run learned to device-copy restored
+    state: orbax-restored arrays can alias host memory the restore machinery
+    owns (zero-copy device_put on CPU), and a directly-called deserialized
+    executable donates its inputs unconditionally, where jit would refuse.
+    Attempt 0 cold-compiles, saves every step, and crashes mid-run; the
+    resumed attempt restores the checkpoint, loads the serialized executable
+    (zero retraces), checkpoint-saves while donating, and must land on the
+    EXACT final loss of an uninterrupted run. A regression here tends to die
+    of SIGSEGV/SIGABRT rather than assert — that is the bug."""
+    from distributeddeeplearning_tpu.train import loop, steps
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    cache = str(tmp_path / "cache")
+    monkeypatch.setenv(compile_cache.ENV_CACHE, cache)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", cache)
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    kw = dict(compile_cache_dir=cache, checkpoint_every_steps=1)
+    try:
+        # Uninterrupted reference: cold-compiles and populates the cache.
+        ref = loop.run(_cfg(checkpoint_dir=str(tmp_path / "ck_ref"), **kw),
+                       total_steps=4, eval_batches=0,
+                       logger=MetricLogger(enabled=False))
+        assert ref["compile_cache"]["sources"]["dp_train_step"] == "compiled"
+
+        # Attempt 0: warm, saves at 1 and 2, then the injected crash.
+        faulted = _cfg(checkpoint_dir=str(tmp_path / "ck"),
+                       fault_plan="crash@2", **kw)
+        with pytest.raises(SystemExit):
+            loop.run(faulted, total_steps=4, eval_batches=0,
+                     logger=MetricLogger(enabled=False))
+
+        # The restart: crash@2 is attempt-0-scoped, so the fingerprint
+        # matches the clean one and the serialized executable is reused on
+        # the restored state — restore, AOT-hit donating dispatches, and
+        # async saves all interleaved.
+        monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+        before = steps.TRACE_COUNTS["dp_train_step"]
+        s = loop.run(faulted, total_steps=4, eval_batches=0,
+                     logger=MetricLogger(enabled=False))
+        assert steps.TRACE_COUNTS["dp_train_step"] == before
+        assert s["compile_cache"]["sources"]["dp_train_step"] == "aot_hit"
+        assert s["start_step"] == 2 and s["final_step"] == 4
+        # Recovery is bitwise: kill + restore + warm executable fully erased.
+        assert s["final_metrics"]["loss"] == ref["final_metrics"]["loss"]
+    finally:
+        jax.config.update("jax_compilation_cache_dir",
+                          compile_cache.default_dir())

@@ -241,9 +241,32 @@ note flash
 # under launch.py --max-restarts (docs/fault_tolerance.md).
 if [ "${DDL_CHAOS:-0}" = "1" ]; then
   check_stop chaos
-  timeout 600 env JAX_PLATFORMS=cpu python bench.py --chaos \
+  # --chaos-cold adds a second faulted run with the compile cache disabled,
+  # so the record carries warm AND cold recovery overhead side by side.
+  timeout 900 env JAX_PLATFORMS=cpu python bench.py --chaos --chaos-cold \
     > "$RES/chaos_recovery.json" 2>> "$RES/log.txt"
   note chaos
+fi
+
+# --- Gated cold-vs-warm start A/B (ask with DDL_COLDSTART=1) --------------
+# Same headline config twice: once against a private EMPTY compile cache
+# (true cold start: full trace + XLA compile) and once against the shared
+# warm cache step 1 populated. Both records carry time_to_first_step_s /
+# compile_time_s (docs/compile_cache.md), so the pair is the measured
+# cold-start tax the persistent cache + AOT executables remove. The cold
+# leg uses its own throwaway dir rather than DDL_COMPILE_CACHE=off so it
+# also re-populates nothing shared.
+if [ "${DDL_COLDSTART:-0}" = "1" ]; then
+  check_stop coldstart_cold
+  rm -rf "$RES/cold_cache" && mkdir -p "$RES/cold_cache"
+  timeout 420 python bench.py --budget 400 --attempts 1 --sweep none \
+    --compile-cache-dir "$RES/cold_cache" \
+    > "$RES/bench_coldstart_cold.json" 2>> "$RES/log.txt"
+  note coldstart_cold
+  check_stop coldstart_warm
+  timeout 420 python bench.py --budget 400 --attempts 1 --sweep none \
+    > "$RES/bench_coldstart_warm.json" 2>> "$RES/log.txt"
+  note coldstart_warm
 fi
 
 # --- Gated telemetry-overhead A/B (ask with DDL_TELEMETRY=1) --------------

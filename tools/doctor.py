@@ -144,13 +144,44 @@ def check_loader() -> None:
         emit("data_loader", ok=False, error=str(e)[:200])
 
 
-def check_caches() -> None:
-    cache = os.path.join(REPO, ".cache", "jax_compile")
-    entries = (len(os.listdir(cache)) if os.path.isdir(cache) else 0)
-    size_mb = 0.0
-    if entries:
-        size_mb = sum(os.path.getsize(os.path.join(cache, f))
-                      for f in os.listdir(cache)) / 1e6
+def check_caches(prune_days: float = 0.0) -> None:
+    """Compile-cache state via the shared policy module
+    (distributeddeeplearning_tpu/perf/compile_cache.py): resolved location
+    (flag > $DDL_COMPILE_CACHE > repo default), entry count / size split
+    into XLA entries vs AOT step executables, and the last run's hit/miss
+    counters from the stats sidecar. ``--prune N`` evicts entries older
+    than N days first."""
+    try:
+        from distributeddeeplearning_tpu.perf import compile_cache
+        cache = compile_cache.resolve_dir()
+        pruned = None
+        if cache and prune_days > 0:
+            removed, kept = compile_cache.prune(
+                cache, max_age_days=prune_days)
+            pruned = {"removed": removed, "kept": kept,
+                      "max_age_days": prune_days}
+        info = compile_cache.summarize(cache)
+        stats = compile_cache.read_stats(cache) if cache else None
+        fields = {
+            "compile_cache_dir": info["dir"],
+            "compile_cache_entries": info["entries"],
+            "compile_cache_aot_entries": info["aot_entries"],
+            "compile_cache_mb": round(info["total_bytes"] / 1e6, 1),
+        }
+        if isinstance(stats, dict):
+            fields["last_run_stats"] = {
+                k: stats[k] for k in ("aot_hits", "aot_misses", "aot_saves",
+                                      "aot_failures", "sources",
+                                      "updated_at")
+                if k in stats}
+        if pruned is not None:
+            fields["pruned"] = pruned
+    except Exception as e:  # doctor must finish; fall back to raw listing
+        cache = os.path.join(REPO, ".cache", "jax_compile")
+        entries = (len(os.listdir(cache)) if os.path.isdir(cache) else 0)
+        fields = {"compile_cache_dir": cache,
+                  "compile_cache_entries": entries,
+                  "policy_error": str(e)[:200]}
     last = None
     try:
         with open(os.path.join(REPO, ".cache", "last_bench.json")) as f:
@@ -159,8 +190,7 @@ def check_caches() -> None:
         last = table.get(key) if isinstance(table, dict) else None
     except (OSError, ValueError):
         pass
-    emit("caches", ok=True, compile_cache_entries=entries,
-         compile_cache_mb=round(size_mb, 1),
+    emit("caches", ok=True, **fields,
          last_bench=({k: last[k] for k in ("value", "measured_at")}
                      if isinstance(last, dict) else None))
 
@@ -168,6 +198,9 @@ def check_caches() -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-timeout", type=int, default=45)
+    p.add_argument("--prune", type=float, default=0.0, metavar="DAYS",
+                   help="evict compile-cache entries older than DAYS "
+                        "before reporting (0 = report only)")
     args = p.parse_args(argv)
     check_accelerator(args.probe_timeout)
     check_cpu_mesh()
@@ -175,7 +208,7 @@ def main(argv=None) -> int:
     check_versions()
     check_native()
     check_loader()
-    check_caches()
+    check_caches(prune_days=args.prune)
     return 0
 
 

@@ -184,6 +184,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "the loader stalled (default 2)")
     p.add_argument("--checkpoint-every", type=int, default=None,
                    help="save a checkpoint every N steps")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent compile cache + AOT step executables "
+                        "(docs/compile_cache.md); default "
+                        "$DDL_COMPILE_CACHE or <repo>/.cache/jax_compile; "
+                        "'off' disables")
     p.add_argument("--tensorboard-dir", default=None,
                    help="mirror metrics into TF summaries at this dir")
     return p.parse_args(argv)
@@ -245,6 +250,8 @@ def build_config(args: argparse.Namespace):
             raise SystemExit(
                 f"--checkpoint-every must be positive (got {args.checkpoint_every})")
         cfg = cfg.replace(checkpoint_every_steps=args.checkpoint_every)
+    if args.compile_cache_dir is not None:
+        cfg = cfg.replace(compile_cache_dir=args.compile_cache_dir)
     if args.accum is not None:
         if args.accum <= 0:
             raise SystemExit(f"--accum must be positive (got {args.accum})")

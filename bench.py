@@ -246,6 +246,12 @@ def _emit_metric(args, value: float, protocol: str,
         "baseline_denominator": BASELINE_DENOMINATOR_NOTE,
     }
     rec.update(_mfu_fields(args, value))
+    # Roofline %-of-peak is ALWAYS present (null when model FLOPs or the
+    # chip's spec peak are unknown): the suite table's comparability
+    # column must exist on every row, not only the lucky ones
+    # (docs/perf_measurement.md; large-batch baselines of arXiv
+    # 1711.04325 compare on this axis).
+    rec["pct_of_peak"] = rec.get("mfu_pct")
     # Structured kernel-config marker (ADVICE r4 bench.py:303): consumers
     # of the last-good table can filter fused-kernel records without
     # parsing the protocol string.
@@ -255,6 +261,13 @@ def _emit_metric(args, value: float, protocol: str,
         rec["fused_conv3"] = True
     if extra:
         rec.update(extra)
+    # This line is a live measurement by THIS process — the only path
+    # allowed to claim ``fresh`` (cached numbers re-enter only through
+    # _emit_error as stale/expired). Runs in the child, so the backend
+    # identity block reflects the devices that actually answered.
+    from distributeddeeplearning_tpu.observability import perf_report
+    perf_report.annotate(rec, provenance="fresh")
+    rec["attempt"] = int(os.environ.get("DDL_BENCH_ATTEMPT", "1") or 1)
     print(json.dumps(rec), flush=True)
 
 
@@ -414,6 +427,15 @@ def _child_measure(args, emit_quick: bool = True,
     # Cold-start annotations (docs/compile_cache.md): every record carries
     # the row's compile cost and whether the AOT executable cache served it.
     cold = {}
+    # The perf/aot.py config fingerprint ties the number to the compiled
+    # program it measured — two records with different fingerprints are
+    # different experiments however similar the CLI looked.
+    try:
+        from distributeddeeplearning_tpu.perf import aot as aotlib
+        cold["config_fingerprint"] = aotlib.config_fingerprint(
+            cfg, total_steps=total)
+    except Exception:
+        pass  # annotation only
     if compile_time_s is not None:
         cold["compile_time_s"] = round(compile_time_s, 2)
         cold["time_to_first_step_s"] = round(time_to_first_step_s, 2)
@@ -605,13 +627,14 @@ def _child(args) -> int:
         try:
             _child_measure(row, emit_quick=False, deadline=row_deadline)
         except Exception as e:  # one OOM must not sink the rest of the suite
+            from distributeddeeplearning_tpu.observability import perf_report
             metric, unit = _metric_name_unit(row)
-            print(json.dumps({
+            print(json.dumps(perf_report.annotate({
                 "metric": metric, "value": None, "unit": unit,
                 "vs_baseline": None,
                 "protocol": _protocol_suffix(row).strip() or None,
                 "error": f"{type(e).__name__}: {e}"[:600],
-            }), flush=True)
+            }, provenance="error")), flush=True)
     return 0
 
 
@@ -639,7 +662,8 @@ def _record_last_good(line: str) -> None:
         pass  # cache is evidence, not correctness
 
 
-def _emit_error(args, msg: str) -> None:
+def _emit_error(args, msg: str, attempts: list | None = None) -> None:
+    from distributeddeeplearning_tpu.observability import perf_report
     metric, unit = _metric_name_unit(args)
     rec = {
         "metric": metric,
@@ -651,23 +675,37 @@ def _emit_error(args, msg: str) -> None:
     # Context for the reader, NOT a measurement: the newest number this
     # harness captured on a live chip (value above stays null — a dead
     # backend yields no result, but the record should say what the same
-    # command measured when the chip last answered). ``stale_age_s`` is
-    # top-level so a consumer can judge freshness without digging the
-    # timestamp out of the nested record.
+    # command measured when the chip last answered). The embedded prior
+    # carries its OWN provenance (stale within --max-stale-age, expired
+    # past it — an expired prior additionally loses vs_baseline: a
+    # week-old cache must not keep scoring against the target).
+    # ``stale_age_s`` is top-level so a consumer can judge freshness
+    # without digging the timestamp out of the nested record.
+    max_age = getattr(args, "max_stale_age",
+                      perf_report.DEFAULT_MAX_STALE_AGE_S)
     try:
         with open(LAST_GOOD_PATH) as f:
             table = json.load(f)
         prior = table.get(metric) if isinstance(table, dict) else None
         if isinstance(prior, dict) and prior.get("metric") == metric:
-            rec["last_measured_on_live_chip"] = prior
-            try:
-                measured = time.mktime(time.strptime(
-                    prior["measured_at"], "%Y-%m-%d %H:%M:%S"))
-                rec["stale_age_s"] = max(0, int(time.time() - measured))
-            except (KeyError, ValueError, TypeError, OverflowError):
-                pass
+            age = perf_report.measurement_age_s(prior.get("measured_at"))
+            labeled = perf_report.stale_record(prior, age, max_age)
+            rec["last_measured_on_live_chip"] = labeled
+            if age is not None:
+                rec["stale_age_s"] = int(age)
+            if labeled["provenance"] == "expired":
+                _note(f"WARNING: cached {metric} measurement is "
+                      f"{'unknown age' if age is None else f'{int(age)}s old'}"
+                      f" (> --max-stale-age {int(max_age)}s): demoted to "
+                      f"provenance=expired, vs_baseline dropped — this "
+                      f"number is history, not a current result")
     except (OSError, ValueError):
         pass
+    # with_backend=False: this runs in the PARENT, which never initialized
+    # jax — probing a backend here could hang on the very tunnel whose
+    # death this record reports.
+    perf_report.annotate(rec, provenance="error", attempts=attempts,
+                         with_backend=False)
     print(json.dumps(rec), flush=True)
 
 
@@ -1025,6 +1063,12 @@ def main(argv=None) -> int:
                    help="total wall-clock budget across all attempts (s); "
                         "guarantees the error record is printed before any "
                         "outer driver timeout can strike")
+    p.add_argument("--max-stale-age", type=float, default=24 * 3600.0,
+                   help="age cap (s) on the cached last-good measurement "
+                        "embedded in error records: younger is labeled "
+                        "provenance=stale (age attached), older is demoted "
+                        "to provenance=expired, loses vs_baseline, and "
+                        "warns loudly (default 24h)")
     p.add_argument("--chaos", action="store_true",
                    help="CPU recovery-overhead benchmark: time a clean tiny "
                         "run vs the same run crashed at --chaos-fail-at and "
@@ -1158,6 +1202,7 @@ def main(argv=None) -> int:
         os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
     last_err = "no attempt ran"
+    attempt_log: list = []  # retry history for the error record's schema
     deadline = time.monotonic() + args.budget
     for attempt in range(args.attempts):
         if attempt:
@@ -1166,7 +1211,13 @@ def main(argv=None) -> int:
         remaining = deadline - time.monotonic()
         if remaining < 30:
             last_err += "; budget exhausted"
+            attempt_log.append({"attempt": attempt + 1,
+                                "rc": "skipped: budget exhausted"})
             break
+        # Children stamp their fresh records with the attempt that produced
+        # them — "landed on attempt 3 of a flaky tunnel" must be readable
+        # off the record (observability/perf_report.py).
+        os.environ["DDL_BENCH_ATTEMPT"] = str(attempt + 1)
         cmd = list(child_cmd)
         if args.suite:
             # The child's row budget excludes backend init (its clock
@@ -1197,13 +1248,15 @@ def main(argv=None) -> int:
             # that then hung or died cannot take it back.
             return 0
         last_err = f"attempt {attempt + 1}: rc={rc}: {err_tail[-600:]}"
+        attempt_log.append({"attempt": attempt + 1, "rc": str(rc),
+                            "relayed_lines": n_lines})
         if isinstance(rc, str) and rc.startswith("preflight"):
             # Backend init hung: further attempts would hang identically.
             # Exit NOW so the total dead-tunnel runtime is one preflight
             # window, not attempts x attempt_timeout.
             break
 
-    _emit_error(args, last_err)
+    _emit_error(args, last_err, attempts=attempt_log)
     return 0
 
 

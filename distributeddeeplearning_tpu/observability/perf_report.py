@@ -1,0 +1,247 @@
+"""One self-describing record schema for every perf number this repo emits.
+
+BENCH_r01-r05 showed where the perf story breaks: 2 of 5 driver rounds
+errored on backend unavailability, and the surviving "current" number was a
+cached measurement re-reported for days (``stale_age_s`` 92824 in r05) with
+nothing in the record saying so loudly. The fix is not better luck with the
+tunnel — it is records that carry their own evidence. Every measurement
+surface (``bench.py`` metric lines, ``train/loop.py`` run summaries,
+``tools/summarize_trace.py`` analyses) emits into the schema defined here:
+
+- ``provenance`` — exactly one of :data:`PROVENANCE_STATES`:
+
+  * ``fresh``   — measured on a live backend by THIS invocation;
+  * ``stale``   — a cached prior measurement re-surfaced within
+    :data:`DEFAULT_MAX_STALE_AGE_S` (age attached);
+  * ``expired`` — a cached measurement older than the cap: context only,
+    never comparable, excluded from ``vs_baseline``;
+  * ``error``   — no measurement; the record explains why.
+
+- ``backend`` — platform/device_kind/device+process counts the number was
+  measured on (a v5e-8 row and a CPU smoke row must never be conflated);
+- ``attempts`` — the retry history that produced (or failed to produce)
+  the number, so "one clean attempt" and "landed on attempt 3 of a flaky
+  tunnel" read differently;
+- ``git_rev`` + ``config_fingerprint`` (perf/aot.py) — which build and
+  which compiled-program-shaping config the number belongs to;
+- roofline accounting via ``models/flops.py`` — ``pct_of_peak`` makes
+  numbers comparable across meshes the way the large-batch ResNet
+  literature reports them (PAPERS.md: arXiv:1711.04325): analytic
+  train FLOPs/example x rate / bf16 peak.
+
+Everything here is annotation, never measurement: every helper is
+no-raise (a missing git dir or an unimportable jax must not cost a
+throughput number) and pure-stdlib unless a guarded import succeeds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+SCHEMA_VERSION = 1
+
+PROVENANCE_STATES = ("fresh", "stale", "expired", "error")
+
+# Past this age a cached number stops being "the current number reported
+# late" and becomes history: demoted to ``expired``, excluded from
+# vs_baseline comparisons (ISSUE 6 satellite: r05 re-reported a 92824 s
+# old cache as current).
+DEFAULT_MAX_STALE_AGE_S = 24 * 3600.0
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def git_rev(repo_root: Optional[str] = None) -> Optional[str]:
+    """Short commit hash of HEAD, read straight from ``.git`` (no
+    subprocess — this runs inside bench children where every fork counts).
+    None when the tree is not a git checkout or HEAD is unreadable."""
+    root = repo_root or _REPO_ROOT
+    git = os.path.join(root, ".git")
+    try:
+        with open(os.path.join(git, "HEAD")) as fh:
+            head = fh.read().strip()
+        if not head.startswith("ref:"):
+            return head[:12] or None  # detached HEAD: the hash itself
+        ref = head.split(None, 1)[1]
+        ref_path = os.path.join(git, *ref.split("/"))
+        if os.path.exists(ref_path):
+            with open(ref_path) as fh:
+                return fh.read().strip()[:12] or None
+        with open(os.path.join(git, "packed-refs")) as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) == 2 and parts[1] == ref:
+                    return parts[0][:12]
+    except OSError:
+        pass
+    return None
+
+
+def backend_identity() -> Optional[dict]:
+    """Which hardware answered: platform, device_kind, device/process
+    counts. Guarded — returns None wherever jax (or the backend) is
+    unavailable, because identity annotation must never initialize or
+    crash a backend on its own."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return {
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?"),
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:
+        return None
+
+
+def roofline(value: Optional[float], model: str, *,
+             seq_len: Optional[int] = None, mlm_positions: int = 0,
+             device_kind: Optional[str] = None) -> dict:
+    """Roofline fields for a rate of ``value`` examples/sec/chip:
+    ``tflops_per_sec`` (analytic model FLOPs actually sustained) and
+    ``pct_of_peak`` (vs the chip's bf16 spec peak — the %-of-peak axis the
+    large-batch ResNet papers compare on). Unknown model or chip omits the
+    respective field; never raises."""
+    out: dict = {}
+    if value is None:
+        return out
+    try:
+        from distributeddeeplearning_tpu.models import flops as flopslib
+        per_ex = flopslib.train_flops_per_example(
+            model, seq_len=seq_len, mlm_positions=mlm_positions)
+        if per_ex is None:
+            return out
+        out["tflops_per_sec"] = round(value * per_ex / 1e12, 2)
+        if device_kind:
+            peak = flopslib.bf16_peak_flops(device_kind)
+            if peak:
+                out["pct_of_peak"] = round(100.0 * value * per_ex / peak, 1)
+                out["bf16_peak_tflops"] = round(peak / 1e12, 0)
+    except Exception:
+        return {}
+    return out
+
+
+def classify_age(age_s: Optional[float],
+                 max_stale_age_s: float = DEFAULT_MAX_STALE_AGE_S) -> str:
+    """``stale`` while a cached number is young enough to still be worth
+    reporting next to an error, ``expired`` past the cap. A cached record
+    is NEVER ``fresh`` — freshness belongs only to this invocation's own
+    measurements, whatever the age says."""
+    if age_s is None:
+        # Unknown age is indistinguishable from arbitrarily old: the
+        # honest label is the conservative one.
+        return "expired"
+    return "stale" if float(age_s) <= float(max_stale_age_s) else "expired"
+
+
+def stale_record(prior: dict, age_s: Optional[float],
+                 max_stale_age_s: float = DEFAULT_MAX_STALE_AGE_S) -> dict:
+    """Label a cached last-good record for embedding into an error record:
+    provenance stale/expired by age, and an expired record loses its
+    ``vs_baseline`` (a week-old number must not keep scoring against the
+    target as if it were current)."""
+    rec = dict(prior)
+    rec["provenance"] = classify_age(age_s, max_stale_age_s)
+    if age_s is not None:
+        rec["stale_age_s"] = int(age_s)
+    if rec["provenance"] == "expired":
+        rec.pop("vs_baseline", None)
+    return rec
+
+
+def measurement_age_s(measured_at: Optional[str],
+                      now: Optional[float] = None) -> Optional[float]:
+    """Seconds since a ``measured_at`` stamp in the last-good table's
+    '%Y-%m-%d %H:%M:%S' format; None when absent/unparseable."""
+    if not measured_at:
+        return None
+    try:
+        measured = time.mktime(time.strptime(measured_at,
+                                             "%Y-%m-%d %H:%M:%S"))
+    except (ValueError, TypeError, OverflowError):
+        return None
+    return max(0.0, (time.time() if now is None else now) - measured)
+
+
+def annotate(rec: dict, *, provenance: str,
+             config: Any = None, total_steps: Optional[int] = None,
+             attempts: Optional[list] = None,
+             stale_age_s: Optional[float] = None,
+             with_backend: bool = True) -> dict:
+    """Stamp a record with the schema's provenance block (in place, and
+    returned). ``config`` (a TrainConfig) adds the perf/aot.py
+    config_fingerprint so the number is tied to the compiled program it
+    measured. ``with_backend=False`` for pure-host analyses (trace
+    summaries) that must not touch jax."""
+    if provenance not in PROVENANCE_STATES:
+        raise ValueError(f"provenance {provenance!r} not in "
+                         f"{PROVENANCE_STATES}")
+    rec["schema_version"] = SCHEMA_VERSION
+    rec["provenance"] = provenance
+    rev = git_rev()
+    if rev:
+        rec["git_rev"] = rev
+    if with_backend:
+        backend = backend_identity()
+        if backend:
+            rec["backend"] = backend
+    if attempts is not None:
+        rec["attempts"] = list(attempts)
+    if stale_age_s is not None:
+        rec["stale_age_s"] = int(stale_age_s)
+    if config is not None:
+        try:
+            from distributeddeeplearning_tpu.perf import aot as aotlib
+            rec["config_fingerprint"] = aotlib.config_fingerprint(
+                config, total_steps=total_steps)
+        except Exception:
+            pass  # fingerprint is annotation; its absence is visible anyway
+    return rec
+
+
+def validate(rec: dict) -> list[str]:
+    """Schema problems in a record (empty list = conforming). The rules
+    tests pin so no surface can quietly drift:
+
+    - provenance present and one of :data:`PROVENANCE_STATES`;
+    - ``fresh`` requires a real value and forbids ``stale_age_s`` — a
+      number served from any cache is by definition not fresh;
+    - ``error`` requires a null value (an error that reports a value is a
+      mislabeled measurement) and an ``error`` message;
+    - ``stale``/``expired`` require the age that justifies the label, and
+      ``expired`` must not carry ``vs_baseline``.
+    """
+    problems = []
+    prov = rec.get("provenance")
+    if prov not in PROVENANCE_STATES:
+        problems.append(f"provenance {prov!r} not in {PROVENANCE_STATES}")
+        return problems
+    if prov == "fresh":
+        # Bench records carry an explicit ``value`` (null on failure);
+        # run summaries measure through other keys and omit it entirely.
+        if "value" in rec and rec["value"] is None:
+            problems.append("fresh record with null value")
+        if rec.get("stale_age_s") is not None:
+            problems.append("fresh record carrying stale_age_s — a cached "
+                            "number must be labeled stale/expired")
+    elif prov == "error":
+        if rec.get("value") is not None:
+            problems.append("error record carrying a value")
+        if not rec.get("error"):
+            problems.append("error record without an error message")
+    else:  # stale / expired
+        if rec.get("stale_age_s") is None:
+            problems.append(f"{prov} record without stale_age_s")
+        if prov == "expired" and rec.get("vs_baseline") is not None:
+            problems.append("expired record still scoring vs_baseline")
+    return problems
+
+
+def dumps(rec: dict) -> str:
+    return json.dumps(rec)

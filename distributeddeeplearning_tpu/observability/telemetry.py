@@ -287,6 +287,54 @@ def load_events(path: str) -> list[dict]:
     return obj.get("traceEvents", []) if isinstance(obj, dict) else list(obj)
 
 
+def load_events_tolerant(path: str) -> tuple[list[dict], Optional[str]]:
+    """Like :func:`load_events`, but salvages a truncated file.
+
+    A crashed process can leave a trace cut mid-write (the export itself
+    is atomic, but ctrl-C'd copies and half-synced artifact pulls are
+    not). Returns ``(events, error)``: on clean parse ``error`` is None;
+    on damage, every complete event object that precedes the cut is
+    recovered one ``raw_decode`` at a time and ``error`` says what was
+    lost — the caller decides how loudly to say it (an analysis that
+    silently drops the tail would misreport phase totals as complete).
+    """
+    try:
+        return load_events(path), None
+    except OSError as e:
+        return [], f"{path}: {e}"
+    except ValueError:
+        pass
+    try:
+        with open(path, errors="replace") as fh:
+            text = fh.read()
+    except OSError as e:
+        return [], f"{path}: {e}"
+    # Find the events array (object form) or the array start (bare form),
+    # then decode complete {...} entries until the truncation point.
+    start = text.find('"traceEvents"')
+    start = text.find("[", start if start >= 0 else 0)
+    if start < 0:
+        return [], f"{path}: unparseable trace (no event array found)"
+    dec = json.JSONDecoder()
+    events: list[dict] = []
+    i = start + 1
+    n = len(text)
+    while i < n:
+        while i < n and text[i] in " \t\r\n,":
+            i += 1
+        if i >= n or text[i] == "]":
+            break
+        try:
+            obj, end = dec.raw_decode(text, i)
+        except ValueError:
+            break  # the truncated tail — everything before it is saved
+        if isinstance(obj, dict):
+            events.append(obj)
+        i = end
+    return events, (f"{path}: truncated trace; recovered "
+                    f"{len(events)} complete event(s)")
+
+
 def phase_totals(events) -> dict[str, dict[str, float]]:
     """Per-phase aggregate over the complete ("X") spans: count, total and
     mean duration in milliseconds, keyed by span name, largest total

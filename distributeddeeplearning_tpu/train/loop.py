@@ -19,7 +19,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.config import (TrainConfig,
+                                                resolve_mlm_max_predictions)
 from distributeddeeplearning_tpu import data as datalib
 from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
@@ -315,6 +316,24 @@ def run(config: TrainConfig, *, total_steps: int,
     spec = model_spec(config.model)
     mesh, model, batch_shd, state, train_step, sched, rng = build(
         config, total_steps)
+    # Roofline denominators for every log-cadence record and the summary:
+    # analytic FLOPs/example x job peak (per-chip spec x device count) —
+    # the %-of-peak axis of observability/perf_report.py. Annotation only:
+    # unknown model or chip leaves the logger without a roofline.
+    try:
+        from distributeddeeplearning_tpu.models import flops as flopslib
+        mlm_pred = (resolve_mlm_max_predictions(
+            config.data.mlm_max_predictions, config.data.seq_len,
+            spec.objective) if spec.input_kind == "tokens" else 0)
+        _per_ex = flopslib.train_flops_per_example(
+            config.model, seq_len=config.data.seq_len,
+            mlm_positions=mlm_pred)
+        _peak = flopslib.bf16_peak_flops(
+            jax.devices()[0].device_kind)
+        logger.set_roofline(
+            _per_ex, _peak * jax.device_count() if _peak else None)
+    except Exception:
+        pass
 
     ckpt = ckptlib.Checkpointer.create(
         config, converter=getattr(train_step, "zero_converter", None))
@@ -600,8 +619,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 # time of the steps still in flight — log-cadence only, so
                 # telemetry adds no fetch of its own.
                 with tele.span("fetch_barrier", step=int(i)):
+                    # now_s=t_log: the logger's step-time window uses the
+                    # SAME clock reading the straggler skew math above
+                    # used — one timestamp per log step, not two
+                    # (utils/logging.py mirrors the record into telemetry
+                    # gauges, closing the duplicated emit path).
                     logger.log(int(i), metrics,
                                examples_per_step=config.global_batch_size,
+                               now_s=t_log,
                                lr=float(sched(i - 1)), **extra)
                 if heartbeat is not None:
                     heartbeat.beat(int(i))
@@ -697,6 +722,22 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             summary["examples_per_sec"] / jax.device_count())
         summary["steps_per_sec"] = (
             total_steps - start_step - warmup_steps) / elapsed
+    # Run summaries emit into the perf_report schema: this summary was
+    # measured by THIS process on the backend below — provenance fresh —
+    # and carries the roofline %-of-peak (null when model FLOPs or the
+    # chip's spec peak are unknown: the field must exist on every summary,
+    # not only the lucky ones).
+    from distributeddeeplearning_tpu.observability import perf_report
+    summary["pct_of_peak"] = perf_report.roofline(
+        summary.get("examples_per_sec_per_chip"), config.model,
+        seq_len=config.data.seq_len,
+        mlm_positions=(resolve_mlm_max_predictions(
+            config.data.mlm_max_predictions, config.data.seq_len,
+            spec.objective) if spec.input_kind == "tokens" else 0),
+        device_kind=getattr(jax.devices()[0], "device_kind", None),
+    ).get("pct_of_peak")
+    perf_report.annotate(summary, provenance="fresh",
+                         config=config, total_steps=total_steps)
     if evaluator is not None:
         final_val = evaluator(state)
         evals.append((end_step, final_val))

@@ -9,10 +9,11 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 from typing import Any, IO, Optional
 
 import jax
+
+from distributeddeeplearning_tpu.observability import telemetry
 
 
 def is_chief() -> bool:
@@ -41,6 +42,19 @@ class MetricLogger:
             self._tb = tf.summary.create_file_writer(tensorboard_dir)
         self._last_time: Optional[float] = None
         self._last_step: Optional[int] = None
+        self._flops_per_example: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+
+    def set_roofline(self, flops_per_example: Optional[float],
+                     peak_flops: Optional[float] = None) -> None:
+        """Roofline denominators for throughput records: analytic train
+        FLOPs per example (models/flops.py) and the job's TOTAL peak
+        (per-chip spec peak x device count). Once set, every record with
+        ``examples_per_sec`` also carries ``tflops_per_sec`` and — when the
+        peak is known — ``pct_of_peak``, the comparability axis bench
+        records and run summaries report (docs/perf_measurement.md)."""
+        self._flops_per_example = flops_per_example
+        self._peak_flops = peak_flops
 
     def __enter__(self) -> "MetricLogger":
         return self
@@ -58,8 +72,16 @@ class MetricLogger:
         self._last_step = None
 
     def log(self, step: int, metrics: dict[str, Any], *,
-            examples_per_step: Optional[int] = None, **extra: Any) -> dict:
-        now = time.perf_counter()
+            examples_per_step: Optional[int] = None,
+            now_s: Optional[float] = None, **extra: Any) -> dict:
+        # One clock for every log-cadence consumer: ``telemetry.now_s``
+        # (the straggler monitor and the trace spans read it too). The
+        # caller passes the reading it already took for straggler skew
+        # math via ``now_s`` so both surfaces see the SAME timestamp —
+        # the logger used to read time.perf_counter() here, a second
+        # clock that could disagree with the telemetry one by the cost
+        # of the straggler allgather.
+        now = telemetry.now_s() if now_s is None else float(now_s)
         if self._last_step is not None and step < self._last_step:
             # Non-monotonic step (restart resumed from an earlier
             # checkpoint): the elapsed time since the pre-restart log is
@@ -73,10 +95,27 @@ class MetricLogger:
                 and step > self._last_step):
             dt = (now - self._last_time) / (step - self._last_step)
             record["step_time_s"] = round(dt, 6)
-            record["examples_per_sec"] = round(examples_per_step / dt, 2)
+            rate = examples_per_step / dt
+            record["examples_per_sec"] = round(rate, 2)
+            if self._flops_per_example:
+                record["tflops_per_sec"] = round(
+                    rate * self._flops_per_example / 1e12, 2)
+                if self._peak_flops:
+                    record["pct_of_peak"] = round(
+                        100.0 * rate * self._flops_per_example
+                        / self._peak_flops, 1)
         record.update(extra)
         self._last_time = now
         self._last_step = step
+        # Single emit path: mirror the numeric fields into the active
+        # telemetry registry as gauges so the trace and the JSONL stream
+        # can never disagree about what a log step reported.
+        tele = telemetry.get()
+        if tele.enabled:
+            for k, v in record.items():
+                if k != "step" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    tele.gauge(k, v, step=int(step))
         if self.enabled:
             line = json.dumps(record)
             print(line, file=self.stream, flush=True)

@@ -46,6 +46,10 @@ def pytest_runtest_logreport(report):
         "nodeid": report.nodeid,
         "duration": report.duration,
         "slow": "slow" in report.keywords,
+        # perf_gate rides along so tools/marker_audit.py can verify the
+        # CPU-proxy gate actually ran in this tier-1 pass (a gate that
+        # silently fell out of the selection is no gate).
+        "perf_gate": "perf_gate" in report.keywords,
     })
 
 

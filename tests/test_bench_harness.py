@@ -482,3 +482,102 @@ def test_last_good_cache_keyed_per_metric(bench, tmp_path):
     with open(bench.LAST_GOOD_PATH) as f:
         table = json.load(f)
     assert table["a"]["value"] == 3 and table["b"]["value"] == 2
+
+
+# --- provenance schema on bench records (ISSUE 6 tentpole) ------------------
+
+def test_metric_record_is_fresh_with_attempt_and_pct_of_peak(
+        bench, monkeypatch, capsys):
+    """Every live metric line carries the full perf_report schema: fresh
+    provenance (the ONLY path allowed to claim it), the attempt that
+    produced it, backend identity, git rev, and an always-present
+    pct_of_peak column (null on CPU where the peak is unknown)."""
+    from distributeddeeplearning_tpu.observability import perf_report
+
+    monkeypatch.setenv("DDL_BENCH_ATTEMPT", "3")
+    args = _args(bench, ["--model", "resnet50"])
+    bench._emit_metric(args, 2366.0, protocol="w11+30 b512")
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["provenance"] == "fresh"
+    assert rec["schema_version"] == perf_report.SCHEMA_VERSION
+    assert rec["attempt"] == 3
+    assert rec["backend"]["platform"] == "cpu"
+    assert rec["backend"]["device_count"] == 8
+    assert len(rec["git_rev"]) == 12
+    # pct_of_peak exists on EVERY row; honest null on an unknown peak.
+    assert "pct_of_peak" in rec and rec["pct_of_peak"] is None
+    assert perf_report.validate(rec) == []
+
+
+def test_error_record_carries_attempt_history_no_backend(bench, capsys):
+    """The parent's error record: provenance=error, the full retry
+    history, and NO backend block — the parent never initialized jax and
+    must not probe the very tunnel whose death it is reporting."""
+    from distributeddeeplearning_tpu.observability import perf_report
+
+    args = _args(bench, ["--model", "resnet50"])
+    bench._emit_error(args, "tunnel down", attempts=[
+        {"attempt": 1, "rc": "timeout 480s", "relayed_lines": 0},
+        {"attempt": 2, "rc": "preflight 75s", "relayed_lines": 0}])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["provenance"] == "error" and rec["value"] is None
+    assert [a["attempt"] for a in rec["attempts"]] == [1, 2]
+    assert "backend" not in rec
+    assert perf_report.validate(rec) == []
+
+
+def test_max_stale_age_demotes_old_cache_to_expired(bench, capsys):
+    """--max-stale-age is the expiry knob: a cached number older than the
+    cap is demoted to provenance=expired, stripped of vs_baseline, and
+    announced LOUDLY on stderr; inside the cap it stays stale and keeps
+    scoring."""
+    import time as _time
+    measured = _time.strftime("%Y-%m-%d %H:%M:%S",
+                              _time.localtime(_time.time() - 7200))
+    bench._record_last_good(json.dumps({
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": 2000.0, "vs_baseline": 1.38, "measured_at": measured}))
+
+    # 2h-old cache under a 1h cap: expired.
+    args = _args(bench, ["--model", "resnet50", "--max-stale-age", "3600"])
+    bench._emit_error(args, "tunnel down")
+    captured = capsys.readouterr()
+    rec = json.loads(captured.out.strip().splitlines()[-1])
+    prior = rec["last_measured_on_live_chip"]
+    assert prior["provenance"] == "expired"
+    assert "vs_baseline" not in prior
+    assert "WARNING" in captured.err and "expired" in captured.err
+    assert "history, not a current result" in captured.err
+
+    # Same cache under the 24h default: stale, vs_baseline kept, quiet.
+    args = _args(bench, ["--model", "resnet50"])
+    assert args.max_stale_age == 24 * 3600.0
+    bench._emit_error(args, "tunnel down")
+    captured = capsys.readouterr()
+    rec = json.loads(captured.out.strip().splitlines()[-1])
+    prior = rec["last_measured_on_live_chip"]
+    assert prior["provenance"] == "stale"
+    assert prior["vs_baseline"] == 1.38
+    assert "WARNING" not in captured.err
+
+
+def test_main_retry_history_lands_in_error_record(bench, monkeypatch,
+                                                  capsys):
+    """End-to-end through main(): each failed attempt appends to the
+    history the final error record ships, and the child env carries the
+    attempt number so fresh records can stamp it."""
+    seen_env = []
+
+    def fake_attempt(cmd, timeout, *, relay_errors, record_good=True,
+                     preflight=0):
+        seen_env.append(os.environ.get("DDL_BENCH_ATTEMPT"))
+        return 0, "backend never came up", 1
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    rc = bench.main(["--attempts", "2"])
+    assert rc == 0
+    assert seen_env == ["1", "2"]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["provenance"] == "error"
+    assert [a["attempt"] for a in rec["attempts"]] == [1, 2]
+    assert all(a["rc"] == "1" for a in rec["attempts"])

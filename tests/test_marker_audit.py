@@ -55,3 +55,58 @@ def test_cli_exit_codes(tmp_path):
                           capture_output=True).returncode == 2
     # threshold override: 200s is fine under a 600s threshold
     assert subprocess.run(cmd + [str(bad), "600"]).returncode == 0
+
+
+# --- perf_gate presence audit (ISSUE 6 satellite) ---------------------------
+
+from tools.marker_audit import audit_perf_gate  # noqa: E402
+
+
+def test_audit_perf_gate_clean_run():
+    records = [_rec("t::fast", 1.0),
+               {**_rec("t::gate", 5.0), "perf_gate": True}]
+    assert audit_perf_gate(records) == []
+
+
+def test_audit_perf_gate_flags_missing_gate():
+    problems = audit_perf_gate([_rec("t::fast", 1.0)])
+    assert len(problems) == 1
+    assert problems[0].startswith("no perf_gate")
+
+
+def test_audit_perf_gate_flags_slow_double_marking():
+    """perf_gate + slow together silently removes the gate from tier-1
+    (-m 'not slow') — the one static mistake that disarms it while every
+    individual run still looks green."""
+    records = [{**_rec("t::gate", 5.0, slow=True), "perf_gate": True}]
+    problems = audit_perf_gate(records)
+    assert len(problems) == 1
+    assert "BOTH perf_gate and slow" in problems[0]
+    assert "t::gate" in problems[0]
+
+
+def test_cli_expect_perf_gate_flag(tmp_path):
+    no_gate = tmp_path / "no_gate.json"
+    no_gate.write_text(json.dumps([_rec("t::fast", 1.0)]))
+    cmd = [sys.executable, "tools/marker_audit.py"]
+    # Partial runs legitimately lack the gate: quiet by default...
+    assert subprocess.run(cmd + [str(no_gate)]).returncode == 0
+    # ...but the tier-1 chain opts in and must then fail loudly.
+    proc = subprocess.run(cmd + [str(no_gate), "--expect-perf-gate"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "no perf_gate-marked test ran" in proc.stdout
+    # With the gate present the opt-in run is clean.
+    with_gate = tmp_path / "gate.json"
+    with_gate.write_text(json.dumps(
+        [{**_rec("t::gate", 5.0), "perf_gate": True}]))
+    assert subprocess.run(
+        cmd + [str(with_gate), "--expect-perf-gate"]).returncode == 0
+    # slow+perf_gate double-marking fails even WITHOUT the opt-in.
+    double = tmp_path / "double.json"
+    double.write_text(json.dumps(
+        [{**_rec("t::gate", 5.0, slow=True), "perf_gate": True}]))
+    proc = subprocess.run(cmd + [str(double)], capture_output=True,
+                          text=True)
+    assert proc.returncode == 1
+    assert "BOTH perf_gate and slow" in proc.stdout

@@ -1,0 +1,162 @@
+"""CPU-proxy perf regression gate (observability/perf_gate.py).
+
+Two layers: compare() band logic pinned on synthetic measurements (every
+violation class, every forgiveness rule), and the LIVE gate — the
+perf_gate-marked tier-1 tests that measure the real proxy workload
+against the checked-in perf_baselines.json and prove the gate flips both
+ways (passes clean, fails under an injected slowdown). The live tests
+are the enforcement point ISSUE 6 puts in tier-1; tools/marker_audit.py
+--expect-perf-gate verifies they actually ran."""
+
+import json
+
+import pytest
+
+from distributeddeeplearning_tpu.observability import perf_gate
+
+
+def _base(**kw):
+    b = {
+        "schema_version": 1,
+        "step_time_ms": 50.0,
+        "calib_unit_ms": 5.0,
+        "normalized_step": 10.0,
+        "phase_share": {"dispatch": 0.90, "data_wait": 0.07,
+                        "fetch_barrier": 0.03},
+        "tolerance": {"step_hi": 3.0, "share_abs": 0.25},
+    }
+    b.update(kw)
+    return b
+
+
+def _cur(step_ms=55.0, norm=11.0, shares=None):
+    return {
+        "step_time_ms": step_ms,
+        "normalized_step": norm,
+        "phase_share": shares or {"dispatch": 0.89, "data_wait": 0.08,
+                                  "fetch_barrier": 0.03},
+    }
+
+
+def test_compare_passes_within_band():
+    assert perf_gate.compare(_base(), _cur()) == []
+
+
+def test_compare_no_baseline_is_a_violation():
+    v = perf_gate.compare(None, _cur())
+    assert len(v) == 1 and "no baseline" in v[0]
+    assert "recalibrate" in v[0]
+
+
+def test_compare_flags_step_time_regression():
+    v = perf_gate.compare(_base(), _cur(step_ms=400.0, norm=80.0))
+    assert any("step-time regression" in s for s in v)
+
+
+def test_compare_forgives_one_sided_inflation():
+    """The dual-ratio rule: a loaded box can inflate RAW step time while
+    the calibration unit inflates alongside (normalized stays sane), and
+    a slow box inflates the normalized-free raw view — only BOTH ratios
+    past the band is a regression."""
+    # Raw 8x but normalized 1.2x: machine got slower, not the code.
+    assert perf_gate.compare(_base(), _cur(step_ms=400.0, norm=12.0)) == []
+    # Normalized 8x but raw 1.2x: calibration caught a load spike.
+    assert perf_gate.compare(_base(), _cur(step_ms=60.0, norm=80.0)) == []
+
+
+def test_compare_flags_phase_mix_shift():
+    """data_wait exploding from 7% to 60% of the step is a pipeline
+    regression even when total step time hides inside the band."""
+    v = perf_gate.compare(_base(), _cur(
+        shares={"dispatch": 0.37, "data_wait": 0.60, "fetch_barrier": 0.03}))
+    assert len(v) == 1 and "phase-mix regression" in v[0]
+    assert "data_wait" in v[0]
+
+
+def test_compare_new_phase_counts_from_zero_share():
+    v = perf_gate.compare(_base(), _cur(
+        shares={"dispatch": 0.60, "surprise_sync": 0.40}))
+    assert any("surprise_sync" in s for s in v)
+
+
+def test_compare_tolerances_come_from_baseline_file():
+    """Loosening/tightening the band is a reviewed perf_baselines.json
+    diff, not a test-local constant."""
+    tight = _base(tolerance={"step_hi": 1.05, "share_abs": 0.25})
+    assert perf_gate.compare(tight, _cur(step_ms=60.0, norm=12.0))
+    loose = _base(tolerance={"step_hi": 50.0, "share_abs": 0.9})
+    assert perf_gate.compare(
+        loose, _cur(step_ms=2000.0, norm=450.0,
+                    shares={"data_wait": 0.8, "dispatch": 0.2})) == []
+
+
+def test_checked_in_baseline_is_valid():
+    """perf_baselines.json ships in the repo and must stay loadable and
+    complete — the live gate is only as real as this file."""
+    baseline = perf_gate.load_baseline()
+    assert baseline is not None, (
+        f"missing/corrupt {perf_gate.BASELINE_PATH}; regenerate with "
+        f"`python tools/perf_gate.py --recalibrate`")
+    assert baseline["normalized_step"] > 0
+    assert baseline["step_time_ms"] > 0
+    assert 0.99 < sum(baseline["phase_share"].values()) < 1.01
+    assert set(baseline["tolerance"]) >= {"step_hi", "share_abs"}
+    assert baseline["workload"]["model"] == perf_gate.WORKLOAD["model"]
+
+
+# --- the live gate ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    """ONE compiled proxy program for all live tests: the injected-
+    slowdown remeasure then costs steps, not a recompile."""
+    return perf_gate.ProxyRunner()
+
+
+@pytest.mark.perf_gate
+def test_gate_passes_on_current_build(runner, monkeypatch, tmp_path):
+    """THE tier-1 perf gate: the current build's proxy measurement must
+    sit inside the checked-in band. If this fails because performance
+    intentionally changed, rerun `python tools/perf_gate.py --recalibrate`
+    and commit the new perf_baselines.json in the same PR."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner)
+    assert result["ok"], "\n".join(result["violations"])
+    cur = result["current"]
+    assert cur["step_time_ms"] > 0 and cur["calib_unit_ms"] > 0
+    # The sidecar doctor.py reads was written and round-trips.
+    with open(tmp_path / "last.json") as fh:
+        assert json.load(fh)["ok"] is True
+
+
+@pytest.mark.perf_gate
+def test_gate_fails_under_injected_slowdown(runner, monkeypatch, tmp_path):
+    """The self-test proving the gate is armed: a deliberate sleep inside
+    the traced data_wait phase must trip BOTH checks — step time out of
+    band and the data_wait share exploding. A gate that cannot fail is
+    decoration."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    baseline = perf_gate.load_baseline()
+    slow = runner.measure(inject_sleep_s=0.25)
+    violations = perf_gate.compare(baseline, slow)
+    assert any("step-time regression" in v for v in violations), violations
+    assert any("phase-mix regression" in v and "data_wait" in v
+               for v in violations), violations
+    # And through the same entry point the gate test above uses — but a
+    # deliberately-slowed pass must never overwrite the doctor sidecar.
+    result = perf_gate.check(runner=runner, inject_sleep_s=0.25)
+    assert not result["ok"]
+    assert not (tmp_path / "last.json").exists()
+
+
+def test_recalibrate_writes_usable_baseline(runner, tmp_path, monkeypatch):
+    out = tmp_path / "baselines.json"
+    baseline = perf_gate.recalibrate(str(out), runner=runner, passes=1)
+    on_disk = perf_gate.load_baseline(str(out))
+    assert on_disk["normalized_step"] == baseline["normalized_step"]
+    assert on_disk["tolerance"] == perf_gate.DEFAULT_TOLERANCE
+    # A build gated against its own fresh recalibration passes.
+    cur = runner.measure()
+    assert perf_gate.compare(on_disk, cur) == [], (on_disk, cur)

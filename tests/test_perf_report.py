@@ -1,0 +1,194 @@
+"""The perf-record schema contract (observability/perf_report.py): the
+provenance rules every measurement surface emits under, pinned against
+synthetic records shaped like the real BENCH_r01-r05 artifacts — the
+driver rounds whose stale-vs-current ambiguity motivated the schema."""
+
+import json
+import time
+
+import pytest
+
+from distributeddeeplearning_tpu.observability import perf_report
+
+
+# --- provenance classification ---------------------------------------------
+
+def test_classify_age_bands():
+    assert perf_report.classify_age(0.0) == "stale"
+    assert perf_report.classify_age(3600.0) == "stale"
+    assert perf_report.classify_age(24 * 3600.0) == "stale"  # inclusive cap
+    assert perf_report.classify_age(24 * 3600.0 + 1) == "expired"
+    # Unknown age is indistinguishable from arbitrarily old.
+    assert perf_report.classify_age(None) == "expired"
+    # Cap is a parameter, not a constant.
+    assert perf_report.classify_age(100.0, max_stale_age_s=50.0) == "expired"
+
+
+def test_cached_record_is_never_fresh():
+    """THE rule of the schema: a record rebuilt from any cache may be
+    stale or expired, never fresh — whatever its age."""
+    prior = {"metric": "m", "value": 2366.0, "vs_baseline": 1.63,
+             "measured_at": "2026-07-31 03:52:00"}
+    for age in (0.0, 1.0, 3600.0, 92824.0, None):
+        rec = perf_report.stale_record(prior, age)
+        assert rec["provenance"] in ("stale", "expired")
+        assert rec["provenance"] != "fresh"
+
+
+def test_stale_record_keeps_vs_baseline_within_cap():
+    prior = {"metric": "m", "value": 2366.0, "vs_baseline": 1.63}
+    rec = perf_report.stale_record(prior, 3600.0)
+    assert rec["provenance"] == "stale"
+    assert rec["stale_age_s"] == 3600
+    assert rec["vs_baseline"] == 1.63
+    assert prior.get("provenance") is None  # input not mutated
+
+
+def test_expired_record_loses_vs_baseline():
+    """r05 shape: stale_age_s 92824 (> 24h) — the cached number must stop
+    scoring against the V100 target as if it were current."""
+    prior = {"metric": "resnet50_imagenet_images_per_sec_per_chip",
+             "value": 2366.0, "vs_baseline": 1.63,
+             "measured_at": "2026-07-31 03:52:00"}
+    rec = perf_report.stale_record(prior, 92824.0)
+    assert rec["provenance"] == "expired"
+    assert "vs_baseline" not in rec
+    assert rec["stale_age_s"] == 92824
+    assert not perf_report.validate(rec)
+
+
+def test_measurement_age_parses_last_good_stamp():
+    now = time.time()
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now - 7200))
+    age = perf_report.measurement_age_s(stamp, now=now)
+    assert age == pytest.approx(7200, abs=2)
+    assert perf_report.measurement_age_s(None) is None
+    assert perf_report.measurement_age_s("not a date") is None
+    # A clock that ran backwards must not yield a negative age.
+    future = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(now + 9999))
+    assert perf_report.measurement_age_s(future, now=now) == 0.0
+
+
+# --- annotate + validate ----------------------------------------------------
+
+def test_annotate_stamps_schema_and_rejects_bad_provenance():
+    rec = perf_report.annotate({"value": 1.0}, provenance="fresh")
+    assert rec["schema_version"] == perf_report.SCHEMA_VERSION
+    assert rec["provenance"] == "fresh"
+    with pytest.raises(ValueError):
+        perf_report.annotate({}, provenance="cached")  # not a state
+
+
+def test_annotate_attempts_and_backend_identity():
+    rec = perf_report.annotate(
+        {"value": 2.0}, provenance="fresh",
+        attempts=[{"attempt": 1, "rc": "timeout 480s"},
+                  {"attempt": 2, "rc": "up"}])
+    assert [a["attempt"] for a in rec["attempts"]] == [1, 2]
+    # conftest pins JAX_PLATFORMS=cpu with 8 fake devices.
+    assert rec["backend"]["platform"] == "cpu"
+    assert rec["backend"]["device_count"] == 8
+    jaxfree = perf_report.annotate({"value": 2.0}, provenance="fresh",
+                                   with_backend=False)
+    assert "backend" not in jaxfree
+
+
+def test_annotate_config_fingerprint_matches_aot():
+    from distributeddeeplearning_tpu.config import TrainConfig
+    from distributeddeeplearning_tpu.perf import aot as aotlib
+    cfg = TrainConfig(model="resnet18_thin", global_batch_size=8)
+    rec = perf_report.annotate({"value": 1.0}, provenance="fresh",
+                               config=cfg, total_steps=10)
+    assert rec["config_fingerprint"] == aotlib.config_fingerprint(
+        cfg, total_steps=10)
+
+
+def test_validate_fresh_rules():
+    assert not perf_report.validate({"provenance": "fresh", "value": 9.0})
+    # Summaries measure through other keys; no explicit value is fine.
+    assert not perf_report.validate({"provenance": "fresh",
+                                     "examples_per_sec": 100.0})
+    assert perf_report.validate({"provenance": "fresh", "value": None})
+    assert perf_report.validate({"provenance": "fresh", "value": 9.0,
+                                 "stale_age_s": 60})
+
+
+def test_validate_error_and_stale_rules():
+    assert not perf_report.validate(
+        {"provenance": "error", "value": None, "error": "tunnel down"})
+    assert perf_report.validate({"provenance": "error", "value": 5.0,
+                                 "error": "x"})
+    assert perf_report.validate({"provenance": "error", "value": None})
+    assert perf_report.validate({"provenance": "stale", "value": 5.0})
+    assert perf_report.validate({"provenance": "expired", "value": 5.0,
+                                 "stale_age_s": 1e6,
+                                 "vs_baseline": 1.63})
+    assert perf_report.validate({"provenance": None})
+    assert perf_report.validate({})
+
+
+# --- roofline ---------------------------------------------------------------
+
+def test_roofline_matches_flops_tables():
+    from distributeddeeplearning_tpu.models import flops as flopslib
+    per_ex = flopslib.train_flops_per_example("resnet50")
+    out = perf_report.roofline(2366.0, "resnet50", device_kind="TPU v5e")
+    assert out["tflops_per_sec"] == round(2366.0 * per_ex / 1e12, 2)
+    peak = flopslib.bf16_peak_flops("TPU v5e")
+    assert out["pct_of_peak"] == round(100.0 * 2366.0 * per_ex / peak, 1)
+    assert out["bf16_peak_tflops"] == round(peak / 1e12, 0)
+
+
+def test_roofline_unknowns_degrade_not_raise():
+    assert perf_report.roofline(None, "resnet50") == {}
+    assert perf_report.roofline(10.0, "no_such_model") == {}
+    out = perf_report.roofline(10.0, "resnet50", device_kind="cpu")
+    assert "tflops_per_sec" in out and "pct_of_peak" not in out
+
+
+# --- r01-r05-shaped synthetic records ---------------------------------------
+
+def _r04_style_error_record(max_age):
+    """Rebuild the r04/r05 artifact shape through the schema helpers the
+    way bench.py's parent does."""
+    prior = {"metric": "resnet50_imagenet_images_per_sec_per_chip",
+             "value": 2366.0, "unit": "images_per_sec_per_chip",
+             "vs_baseline": 1.63, "protocol": "w11+30 b512",
+             "measured_at": "2026-07-31 03:52:00"}
+    age = 92824.0
+    rec = {"metric": prior["metric"], "value": None,
+           "unit": prior["unit"], "vs_baseline": None,
+           "error": ("attempt 1: rc=preflight 75s: backend never came up "
+                     "(tunnel presumed down)"),
+           "last_measured_on_live_chip":
+               perf_report.stale_record(prior, age, max_age),
+           "stale_age_s": int(age)}
+    return perf_report.annotate(
+        rec, provenance="error",
+        attempts=[{"attempt": 1, "rc": "preflight 75s"}],
+        with_backend=False)
+
+
+def test_r04_shape_error_record_validates_and_labels_cache():
+    rec = _r04_style_error_record(max_age=24 * 3600.0)
+    assert not perf_report.validate(rec)
+    assert rec["provenance"] == "error"
+    embedded = rec["last_measured_on_live_chip"]
+    assert embedded["provenance"] == "expired"  # 92824s > 24h
+    assert "vs_baseline" not in embedded
+    assert not perf_report.validate(embedded)
+    # Raising the cap past the age keeps the cache comparable.
+    young = _r04_style_error_record(max_age=7 * 24 * 3600.0)
+    assert young["last_measured_on_live_chip"]["provenance"] == "stale"
+    assert young["last_measured_on_live_chip"]["vs_baseline"] == 1.63
+    # The whole artifact round-trips as one JSON line (driver contract).
+    assert json.loads(perf_report.dumps(rec))["provenance"] == "error"
+
+
+def test_git_rev_reads_head():
+    rev = perf_report.git_rev()
+    # This repo IS a git checkout; the rev must resolve and look like one.
+    assert rev and len(rev) == 12
+    assert all(c in "0123456789abcdef" for c in rev)
+    assert perf_report.git_rev("/no/such/root") is None

@@ -353,3 +353,70 @@ def test_make_monitor_gating():
     assert straggler.make_monitor(TrainConfig(model="resnet18")) is None
     mon = straggler.StragglerMonitor(1.5, 2)  # what multi-process builds
     assert mon.threshold == 1.5 and mon.num_processes == 2
+
+
+# --- MetricLogger <-> telemetry single emit path (ISSUE 6 satellite) --------
+
+
+def test_metric_logger_uses_caller_clock_and_mirrors_gauges():
+    """One clock, one emit: the step-time window is computed from the
+    ``now_s`` reading the caller already took for the straggler monitor
+    (not a second internal clock that can disagree by the cost of the
+    straggler allgather), and every numeric field of the record is
+    mirrored into the active telemetry registry so trace and JSONL can
+    never diverge."""
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    try:
+        telemetry.configure(enabled=True)
+        logger = MetricLogger(stream=open(os.devnull, "w"), enabled=True)
+        logger.log(1, {"loss": 2.0}, examples_per_step=8, now_s=100.0)
+        rec = logger.log(2, {"loss": 1.5}, examples_per_step=8,
+                         now_s=100.5, lr=0.1)
+        # Exactly the caller's readings: 0.5 s apart — impossible to get
+        # from an internal wall clock in a microsecond-fast test.
+        assert rec["step_time_s"] == 0.5
+        assert rec["examples_per_sec"] == 16.0
+        gauges = {}
+        for e in telemetry.get().snapshot():
+            if e.get("ph") == "C":
+                gauges.setdefault(e["name"], []).append(
+                    e["args"]["value"])
+        for key in ("loss", "step_time_s", "examples_per_sec", "lr"):
+            assert key in gauges, f"{key} not mirrored into telemetry"
+        assert gauges["loss"] == [2.0, 1.5]
+        assert gauges["examples_per_sec"][-1] == 16.0
+        logger.close()
+    finally:
+        telemetry.reset()
+
+
+def test_metric_logger_no_mirroring_when_telemetry_disabled():
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    telemetry.reset()  # the disabled singleton
+    logger = MetricLogger(stream=open(os.devnull, "w"), enabled=True)
+    logger.log(1, {"loss": 2.0}, now_s=1.0)
+    assert telemetry.get().snapshot() == []
+    logger.close()
+
+
+def test_metric_logger_roofline_pct_of_peak():
+    """set_roofline turns every throughput record into a roofline record:
+    tflops_per_sec always, pct_of_peak when the peak is known — the
+    log-cadence %-of-peak line ISSUE 6's tentpole requires."""
+    from distributeddeeplearning_tpu.utils.logging import MetricLogger
+
+    logger = MetricLogger(stream=open(os.devnull, "w"), enabled=True)
+    logger.set_roofline(1e9, 1e12)  # 1 GFLOP/example, 1 TFLOP/s peak
+    logger.log(1, {}, examples_per_step=100, now_s=10.0)
+    rec = logger.log(2, {}, examples_per_step=100, now_s=11.0)
+    assert rec["examples_per_sec"] == 100.0
+    assert rec["tflops_per_sec"] == 0.1
+    assert rec["pct_of_peak"] == 10.0
+    # Unknown peak (CPU): tflops still reported, pct honestly absent.
+    logger.set_roofline(1e9, None)
+    logger.log(3, {}, examples_per_step=100, now_s=12.0)
+    rec = logger.log(4, {}, examples_per_step=100, now_s=13.0)
+    assert rec["tflops_per_sec"] == 0.1 and "pct_of_peak" not in rec
+    logger.close()

@@ -1,0 +1,173 @@
+"""Trace-analysis edge cases (ISSUE 6 satellite): the damaged, partial,
+and merged traces a post-mortem actually hands to telemetry.phase_totals
+/ tools/summarize_trace.py — empty trace dir, truncated JSON, events
+merged across restart attempts, instants-only traces."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from distributeddeeplearning_tpu.observability import telemetry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools import summarize_trace  # noqa: E402
+
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ph": "X", "ts": ts, "dur": dur, "pid": 0,
+            "tid": 1, "args": args}
+
+
+def _write(path, events):
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return str(path)
+
+
+# --- load_events_tolerant ---------------------------------------------------
+
+def test_tolerant_load_clean_file_has_no_error(tmp_path):
+    p = _write(tmp_path / "t.json", [_span("dispatch", 0, 100)])
+    events, err = telemetry.load_events_tolerant(p)
+    assert err is None and len(events) == 1
+
+
+def test_tolerant_load_salvages_truncated_object_form(tmp_path):
+    p = _write(tmp_path / "t.json", [_span("dispatch", 0, 100),
+                                     _span("data_wait", 100, 50),
+                                     _span("dispatch", 200, 100)])
+    text = open(p).read()
+    cut = text.rindex('{"name"')  # kill the 3rd event mid-object
+    with open(p, "w") as fh:
+        fh.write(text[:cut + 20])
+    events, err = telemetry.load_events_tolerant(p)
+    assert [e["name"] for e in events] == ["dispatch", "data_wait"]
+    assert err and "truncated" in err and "2" in err
+
+
+def test_tolerant_load_salvages_bare_array_form(tmp_path):
+    p = str(tmp_path / "bare.json")
+    full = json.dumps([_span("a", 0, 10), _span("b", 10, 10)])
+    with open(p, "w") as fh:
+        fh.write(full[:full.rindex('"name": "b"') + 4])  # cut inside b
+    events, err = telemetry.load_events_tolerant(p)
+    assert [e["name"] for e in events] == ["a"]
+    assert err and "truncated" in err
+
+
+def test_tolerant_load_garbage_and_missing(tmp_path):
+    p = str(tmp_path / "garbage.json")
+    with open(p, "w") as fh:
+        fh.write("this is not a trace")
+    events, err = telemetry.load_events_tolerant(p)
+    assert events == [] and "unparseable" in err
+    events, err = telemetry.load_events_tolerant(str(tmp_path / "nope"))
+    assert events == [] and err
+
+
+# --- phase_totals edge cases ------------------------------------------------
+
+def test_phase_totals_empty_and_zero_duration():
+    assert telemetry.phase_totals([]) == {}
+    totals = telemetry.phase_totals([
+        _span("x", 0, 0),  # zero-duration span still counts
+        {"name": "i1", "ph": "i", "ts": 5},  # instants never do
+        {"name": "c1", "ph": "C", "ts": 5, "args": {"value": 1.0}},
+    ])
+    assert totals == {"x": {"count": 1, "total_ms": 0.0, "mean_ms": 0.0}}
+
+
+# --- summarize_trace CLI ----------------------------------------------------
+
+def test_empty_trace_dir_is_an_error_record_not_a_crash(tmp_path, capsys):
+    d = tmp_path / "empty_traces"
+    d.mkdir()
+    assert summarize_trace.main([str(d), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["provenance"] == "error"
+    assert rec["events"] == 0 and rec["phases"] == {}
+    assert any("no trace" in e for e in rec["load_errors"])
+    # Table mode reports the same truth on stderr without crashing.
+    assert summarize_trace.main([str(d)]) == 0
+    assert "no trace" in capsys.readouterr().err
+
+
+def test_truncated_trace_summarizes_salvaged_prefix(tmp_path, capsys):
+    p = _write(tmp_path / "t.json", [_span("dispatch", 0, 1000),
+                                     _span("dispatch", 1000, 1000),
+                                     _span("data_wait", 2000, 500)])
+    text = open(p).read()
+    with open(p, "w") as fh:
+        fh.write(text[:text.rindex('{"name"') + 10])
+    assert summarize_trace.main([p, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    # Salvage kept the 2 complete dispatch spans; the cut data_wait is
+    # gone — and the record SAYS so instead of posing as complete.
+    assert rec["provenance"] == "fresh"
+    assert rec["phases"]["dispatch"]["count"] == 2
+    assert "data_wait" not in rec["phases"]
+    assert rec["load_errors"] and "truncated" in rec["load_errors"][0]
+    assert summarize_trace.main([p]) == 0  # table mode
+    assert "incomplete" in capsys.readouterr().out
+
+
+def test_events_merged_across_restart_attempts(tmp_path, capsys):
+    """A chaos run's attempts export into ONE file (telemetry.export
+    merges); the summary must aggregate across attempts, not just the
+    last one."""
+    path = str(tmp_path / "trace.p0.json")
+    att0 = telemetry.Telemetry(enabled=True)
+    with att0.span("dispatch", step=1):
+        pass
+    att0.instant("fault:crash", step=1)
+    assert att0.export(path) == path
+    att1 = telemetry.Telemetry(enabled=True)  # the restarted attempt
+    with att1.span("dispatch", step=1):
+        pass
+    with att1.span("restore", step=1):
+        pass
+    assert att1.export(path) == path
+    assert summarize_trace.main([path, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["phases"]["dispatch"]["count"] == 2  # both attempts
+    assert rec["phases"]["restore"]["count"] == 1
+    assert [e["name"] for e in rec["instants"]] == ["fault:crash"]
+
+
+def test_instants_only_trace(tmp_path, capsys):
+    """A run that died before any span completed still yields a valid
+    summary: timeline present, no phases — not a crash, not a lie."""
+    tele = telemetry.Telemetry(enabled=True)
+    tele.instant("fault:sigkill", step=3)
+    tele.instant("restart_scheduled", attempt=1)
+    path = str(tmp_path / "trace.p0.json")
+    tele.export(path)
+    assert summarize_trace.main([path, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["provenance"] == "fresh" and rec["phases"] == {}
+    assert len(rec["instants"]) == 2
+    assert summarize_trace.main([path]) == 0
+    assert "no complete spans" in capsys.readouterr().out
+
+
+def test_directory_expands_to_per_process_traces(tmp_path, capsys):
+    d = tmp_path / "traces"
+    d.mkdir()
+    for pid in (0, 1):
+        t = telemetry.Telemetry(enabled=True, process_index=pid)
+        with t.span("dispatch", step=1):
+            pass
+        t.export(str(d / f"trace.p{pid}.json"))
+    (d / "unrelated.txt").write_text("not a trace")
+    assert summarize_trace.main([str(d), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["processes"] == [0, 1]
+    assert rec["phases"]["dispatch"]["count"] == 2
+    assert len(rec["files"]) == 2  # unrelated.txt was never touched
+
+
+def test_missing_path_still_exits_loudly(tmp_path):
+    with pytest.raises(SystemExit):
+        summarize_trace.main([str(tmp_path / "missing.json")])

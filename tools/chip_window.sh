@@ -64,6 +64,26 @@ note() {
 
 echo "[$(stamp)] window open" >> "$RES/log.txt"
 
+# 0. Preflight: bounded-retry backend probe (tools/preflight.py, 2 x 20 s
+# + 3 s backoff ~= 45 s worst case). r04/r05 burned 75 s of bench-harness
+# preflight each — and r02/r03 199-219 s of timeouts — discovering the
+# tunnel was down; this answers in seconds. On a dead tunnel the probe's
+# error-provenance record BECOMES the window's headline artifact (the
+# driver reads bench_headline.json either way) and the window exits
+# immediately rather than walking every step into the same wall.
+check_stop preflight
+timeout 90 python tools/preflight.py --out "$RES/preflight.json" \
+  >> "$RES/log.txt" 2>&1
+PREFLIGHT_RC=$?
+(exit "$PREFLIGHT_RC")  # note() reads $?; restore the probe's rc for it
+note preflight
+if [ "$PREFLIGHT_RC" -ne 0 ]; then
+  cp "$RES/preflight.json" "$RES/bench_headline.json" 2>/dev/null || true
+  echo "[$(stamp)] tunnel down (preflight rc=$PREFLIGHT_RC): window aborted" \
+    >> "$RES/log.txt"
+  exit 0
+fi
+
 # --- Priority prefix: fits a ~25-min window -------------------------------
 
 # 1. Headline bench, quick protocol first (P50 ~3 min warm-cache; the

@@ -190,9 +190,41 @@ def check_caches(prune_days: float = 0.0) -> None:
         last = table.get(key) if isinstance(table, dict) else None
     except (OSError, ValueError):
         pass
-    emit("caches", ok=True, **fields,
-         last_bench=({k: last[k] for k in ("value", "measured_at")}
-                     if isinstance(last, dict) else None))
+    last_fields = None
+    if isinstance(last, dict):
+        last_fields = {k: last.get(k) for k in ("value", "measured_at")}
+        # Provenance verdict on the cached number (perf_report age rules):
+        # how stale the headline the next error record would lean on
+        # already is — "fresh 2h ago" and "expired, 6 days old" are
+        # different situations a window planner must distinguish.
+        try:
+            from distributeddeeplearning_tpu.observability import perf_report
+            age = perf_report.measurement_age_s(last.get("measured_at"))
+            last_fields["age_s"] = None if age is None else int(age)
+            last_fields["provenance_if_reused"] = perf_report.classify_age(
+                age)
+            if "pct_of_peak" in last:
+                last_fields["pct_of_peak"] = last["pct_of_peak"]
+        except Exception:
+            pass
+    emit("caches", ok=True, **fields, last_bench=last_fields)
+
+
+def check_perf_gate() -> None:
+    """CPU-proxy perf-gate state WITHOUT running the proxy (that is
+    tier-1's job): baseline presence/recording info + the last recorded
+    check from .cache/perf_gate_last.json — so a failing gate is
+    diagnosable (which phase, how far out of band) straight from doctor
+    output, no pytest rerun needed."""
+    try:
+        from distributeddeeplearning_tpu.observability import perf_gate
+        st = perf_gate.status()
+        last = st.get("last_check")
+        ok = bool(st["baseline_present"]) and (last is None
+                                              or bool(last.get("ok")))
+        emit("perf_gate", ok=ok, **st)
+    except Exception as e:
+        emit("perf_gate", ok=False, error=str(e)[:200])
 
 
 def main(argv=None) -> int:
@@ -209,6 +241,7 @@ def main(argv=None) -> int:
     check_native()
     check_loader()
     check_caches(prune_days=args.prune)
+    check_perf_gate()
     return 0
 
 

@@ -47,13 +47,41 @@ def find_violations(records, threshold_s: float = DEFAULT_THRESHOLD_S):
     return sorted(out, key=lambda r: -float(r["duration"]))
 
 
+def audit_perf_gate(records) -> list[str]:
+    """Problems with the CPU-proxy perf gate's presence in this run.
+
+    The gate (tests marked ``perf_gate``, observability/perf_gate.py)
+    only protects anything while it actually executes in tier-1 — two
+    silent failure modes would disarm it without failing anything:
+    the marked tests disappear from the selection (renamed, deselected,
+    collection error), or someone marks them ``slow`` and tier-1's
+    ``-m 'not slow'`` filters the gate out. Both become loud here.
+    """
+    problems = []
+    gate = [r for r in records if r.get("perf_gate")]
+    if not gate:
+        problems.append(
+            "no perf_gate-marked test ran — the CPU-proxy perf gate is "
+            "not protecting this run (tests/test_perf_gate.py missing, "
+            "renamed, or deselected?)")
+    for rec in gate:
+        if rec.get("slow"):
+            problems.append(
+                f"{rec.get('nodeid')} is marked BOTH perf_gate and slow — "
+                f"tier-1 runs -m 'not slow', so this silently removes the "
+                f"perf gate from tier-1")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
-              f"{DEFAULT_THRESHOLD_S:g}]")
+              f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate]")
         return 0 if argv else 2
+    expect_gate = "--expect-perf-gate" in argv
+    argv = [a for a in argv if a != "--expect-perf-gate"]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -62,14 +90,24 @@ def main(argv=None) -> int:
         print(f"marker-audit: cannot read {argv[0]}: {e}", file=sys.stderr)
         return 2
     violations = find_violations(records, threshold)
-    if not violations:
+    # slow+perf_gate double-marking is checked on EVERY audit (it is a
+    # static mistake); the ran-at-all check is opt-in, because partial
+    # runs (pytest tests/test_flops.py) legitimately lack the gate.
+    gate_problems = audit_perf_gate(records)
+    if not expect_gate:
+        gate_problems = [p for p in gate_problems
+                         if not p.startswith("no perf_gate")]
+    if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")
         return 0
-    print(f"marker-audit: {len(violations)} test(s) over {threshold:g}s "
-          f"without @pytest.mark.slow ({BUDGET_NOTE}):")
-    for rec in violations:
-        print(f"  {rec['duration']:7.1f}s  {rec['nodeid']}")
+    if violations:
+        print(f"marker-audit: {len(violations)} test(s) over {threshold:g}s "
+              f"without @pytest.mark.slow ({BUDGET_NOTE}):")
+        for rec in violations:
+            print(f"  {rec['duration']:7.1f}s  {rec['nodeid']}")
+    for p in gate_problems:
+        print(f"marker-audit: {p}")
     return 1
 
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Fast backend preflight with bounded retry: is the chip tunnel alive?
+
+    python tools/preflight.py [--timeout 20] [--attempts 2] [--backoff 3]
+                              [--out PATH]
+
+Probes backend init in a SUBPROCESS (a hung ``jax.devices()`` must be
+killable) with a hard per-attempt timeout and bounded backoff between
+attempts. Exit 0 when the backend answered; exit 1 when it never did.
+Either way, ONE perf_report-schema record lands on stdout (and in
+``--out`` when given):
+
+  * up   — ``provenance: fresh``, value = init seconds, backend identity;
+  * down — ``provenance: error``, value null, full attempt history.
+
+The point (BENCH_r02-r05): a dead tunnel used to cost 75-219 s of
+bench-harness timeouts before the window learned the truth. This probe
+answers in seconds and its error record is a valid bench artifact, so
+``chip_window.sh`` can fail the whole window fast AND leave evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.observability import perf_report  # noqa: E402
+
+_PROBE = """
+import json, jax
+d = jax.devices()[0]
+print(json.dumps({"platform": d.platform,
+                  "device_kind": getattr(d, "device_kind", "?"),
+                  "device_count": jax.device_count(),
+                  "process_count": jax.process_count()}), flush=True)
+"""
+
+
+def probe_once(timeout: float) -> tuple[dict | None, str]:
+    """One subprocess probe. Returns (backend_identity, "") on success or
+    (None, reason) on failure; never raises, never hangs past timeout."""
+    t0 = time.monotonic()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE], capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"probe timed out after {timeout:g}s (tunnel hung)"
+    except OSError as e:
+        return None, f"probe failed to launch: {e}"
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-3:]
+        return None, (f"probe rc={out.returncode}: "
+                      + (" | ".join(tail) or "no stderr"))
+    for line in reversed((out.stdout or "").splitlines()):
+        try:
+            ident = json.loads(line)
+            if isinstance(ident, dict) and "platform" in ident:
+                ident["init_s"] = round(time.monotonic() - t0, 2)
+                return ident, ""
+        except ValueError:
+            continue
+    return None, "probe printed no identity line"
+
+
+def run(timeout: float = 20.0, attempts: int = 2,
+        backoff: float = 3.0) -> dict:
+    """Bounded-retry probe; returns the schema record (never raises)."""
+    history: list[dict] = []
+    for attempt in range(1, max(attempts, 1) + 1):
+        if attempt > 1:
+            time.sleep(backoff)
+        ident, reason = probe_once(timeout)
+        if ident is not None:
+            rec = {
+                "metric": "backend_preflight",
+                "value": ident.pop("init_s", None),
+                "unit": "s_to_backend_up",
+                "backend": ident,
+            }
+            history.append({"attempt": attempt, "rc": "up"})
+            # with_backend=False: identity comes from the CHILD that
+            # actually initialized; the parent must stay jax-free.
+            return perf_report.annotate(rec, provenance="fresh",
+                                        attempts=history,
+                                        with_backend=False)
+        history.append({"attempt": attempt, "rc": reason})
+    rec = {
+        "metric": "backend_preflight",
+        "value": None,
+        "unit": "s_to_backend_up",
+        "error": (f"backend never came up in {attempts} attempt(s) x "
+                  f"{timeout:g}s: {history[-1]['rc']}"),
+    }
+    return perf_report.annotate(rec, provenance="error", attempts=history,
+                                with_backend=False)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--timeout", type=float, default=20.0,
+                   help="per-attempt probe timeout (s); live-chip init "
+                        "lands in seconds, so 20 is generous")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="bounded retries before declaring the tunnel down")
+    p.add_argument("--backoff", type=float, default=3.0,
+                   help="sleep (s) between attempts")
+    p.add_argument("--out", default=None,
+                   help="also write the record to this path")
+    args = p.parse_args(argv)
+    rec = run(timeout=args.timeout, attempts=args.attempts,
+              backoff=args.backoff)
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 0 if rec["provenance"] == "fresh" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

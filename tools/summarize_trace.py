@@ -2,6 +2,7 @@
 """Per-phase breakdown of a telemetry Chrome-trace JSON.
 
     python tools/summarize_trace.py TRACE.json [TRACE2.json ...] [--json]
+    python tools/summarize_trace.py TRACE_DIR [--json]
 
 Reads trace files written by --trace-dir (train.py, bench.py, or a
 launch.py-merged chaos run) and prints, per file set:
@@ -12,13 +13,21 @@ launch.py-merged chaos run) and prints, per file set:
     straggler warnings, preemptions — in monotonic-clock order;
   * counter tracks (HBM gauges, cumulative counts) as last-value + peak.
 
-``--json`` emits one machine-readable object instead of the tables.
+A directory argument expands to its ``trace.p*.json`` files (the
+--trace-dir layout). Truncated files are salvaged event-by-event and
+reported, not fatal — a post-mortem's trace is exactly the one most
+likely to be damaged.
+
+``--json`` emits one machine-readable object in the
+observability/perf_report.py record schema (``provenance`` is ``fresh``
+when every file parsed clean, ``error`` when nothing could be read).
 Pure stdlib + the telemetry module's loaders; no jax, safe anywhere.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -26,13 +35,32 @@ import sys
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributeddeeplearning_tpu.observability import perf_report  # noqa: E402
 from distributeddeeplearning_tpu.observability import telemetry  # noqa: E402
+
+
+def expand_traces(args: list[str]) -> list[str]:
+    """Each argument is a trace file or a --trace-dir directory; a
+    directory contributes its ``trace.p*.json`` files (sorted, so
+    multi-process output is stable). An empty directory contributes
+    nothing — the caller reports that, it is not an error here."""
+    out: list[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            out.extend(sorted(glob.glob(os.path.join(a, "trace.p*.json"))))
+        else:
+            out.append(a)
+    return out
 
 
 def summarize(paths: list[str]) -> dict:
     events: list[dict] = []
+    load_errors: list[str] = []
     for p in paths:
-        events.extend(telemetry.load_events(p))
+        evs, err = telemetry.load_events_tolerant(p)
+        events.extend(evs)
+        if err:
+            load_errors.append(err)
     phases = telemetry.phase_totals(events)
     instants = sorted((e for e in events if e.get("ph") == "i"),
                       key=lambda e: e.get("ts", 0))
@@ -49,6 +77,7 @@ def summarize(paths: list[str]) -> dict:
     return {
         "files": paths,
         "events": len(events),
+        "load_errors": load_errors,
         "processes": pids,
         "phases": phases,
         "instants": [{"name": e["name"], "ts_us": e.get("ts", 0),
@@ -62,6 +91,8 @@ def print_tables(s: dict) -> None:
     total_ms = sum(p["total_ms"] for p in s["phases"].values()) or 1.0
     print(f"{len(s['files'])} file(s), {s['events']} events, "
           f"processes {s['processes']}")
+    for err in s.get("load_errors", ()):
+        print(f"WARNING: {err} — totals below are incomplete")
     if s["phases"]:
         print(f"\n{'phase':<40}{'count':>8}{'total_ms':>12}"
               f"{'mean_ms':>10}{'share':>8}")
@@ -88,19 +119,38 @@ def print_tables(s: dict) -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("traces", nargs="+",
-                   help="Chrome-trace JSON file(s) from --trace-dir")
+                   help="Chrome-trace JSON file(s), or --trace-dir "
+                        "directories (expanded to trace.p*.json)")
     p.add_argument("--json", action="store_true",
-                   help="emit one machine-readable JSON object instead "
+                   help="emit one machine-readable JSON object (record "
+                        "schema of observability/perf_report.py) instead "
                         "of tables")
     args = p.parse_args(argv)
-    missing = [t for t in args.traces if not os.path.exists(t)]
+    missing = [t for t in args.traces
+               if not os.path.isdir(t) and not os.path.exists(t)]
     if missing:
-        p.error(f"no such trace file(s): {missing}")
-    s = summarize(args.traces)
+        p.error(f"no such trace file or directory: {missing}")
+    paths = expand_traces(args.traces)
+    s = summarize(paths)
+    if not paths:
+        s["load_errors"].append(
+            f"no trace.p*.json files under {args.traces} — nothing traced "
+            f"yet, or the run wrote to a different --trace-dir")
+    # This analysis is "fresh" only when every requested file parsed
+    # clean; damage demotes nothing to stale (there is no cache here) but
+    # an empty read is an error record, not a zero-phase measurement.
+    # with_backend=False: a trace reader must never import jax.
+    if s["events"]:
+        perf_report.annotate(s, provenance="fresh", with_backend=False)
+    else:
+        s["error"] = "; ".join(s["load_errors"]) or "no events"
+        perf_report.annotate(s, provenance="error", with_backend=False)
     if args.json:
         print(json.dumps(s))
     else:
         print_tables(s)
+        for err in (s["load_errors"] if not s["events"] else ()):
+            print(f"ERROR: {err}", file=sys.stderr)
     return 0
 
 

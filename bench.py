@@ -102,6 +102,15 @@ SUITE = (
     # opt_state bytes every record now carries. Never measured on chip.
     ("zero1", "resnet50", {"allreduce_bucket_mb": 4.0,
                            "optimizer_sharding": "zero1"}, 90),
+    # ZeRO-2/3 complete the ladder (same pairing discipline as zero1):
+    # zero2 keeps grads reduce-scattered per bucket (never materializing
+    # the full grad tree), zero3 stores params 1/N-chunked and all-gathers
+    # them per bucket on demand — both with the backward/collective
+    # overlapped schedule on by default. Never measured on chip.
+    ("zero2", "resnet50", {"allreduce_bucket_mb": 4.0,
+                           "optimizer_sharding": "zero2"}, 90),
+    ("zero3", "resnet50", {"allreduce_bucket_mb": 4.0,
+                           "optimizer_sharding": "zero3"}, 90),
     # Never measured on chip under the gather-head protocol (r2 protocol
     # change) — the two highest-value unknown rows.
     ("bert512_flash", "bert_base", {"batch_size": 32, "seq_len": 512,
@@ -150,11 +159,12 @@ def _metric_name_unit(args) -> tuple[str, str]:
     # last-good entry under the same key.
     perleaf = ("_perleaf_ar"
                if getattr(args, "allreduce_bucket_mb", None) == 0 else "")
-    # ZeRO-1 rows likewise get their own metric name: the sharded-optimizer
+    # ZeRO rows likewise get their own metric name per stage: each sharded
     # schedule is a different measurement protocol and its number must not
     # evict the replicated headline's last-good entry.
-    if getattr(args, "optimizer_sharding", None) == "zero1":
-        perleaf += "_zero1"
+    stage = getattr(args, "optimizer_sharding", None)
+    if stage and stage != "none":
+        perleaf += f"_{stage}"
     # Tracing adds per-step clock reads inside the timed window — protocol
     # drift by design (it's how the overhead A/B measures itself), so traced
     # numbers live under their own metric name and can never evict an
@@ -192,8 +202,14 @@ def _protocol_suffix(args) -> str:
         parts.append("perleaf-ar" if ar_mb == 0 else f"ar{ar_mb:g}mb")
     if getattr(args, "allreduce_dtype", None) == "bfloat16":
         parts.append("ar-bf16")
-    if getattr(args, "optimizer_sharding", None) == "zero1":
-        parts.append("zero1")
+    stage = getattr(args, "optimizer_sharding", None)
+    if stage and stage != "none":
+        parts.append(stage)
+        if stage in ("zero2", "zero3") and \
+                getattr(args, "overlap_collectives", True) is False:
+            parts.append("no-overlap")
+    if getattr(args, "opt_state_offload", False):
+        parts.append("opt-offload")
     if getattr(args, "trace_dir", None):
         parts.append("tele")
     return (" " + "+".join(parts)) if parts else ""
@@ -340,7 +356,9 @@ def _child_measure(args, emit_quick: bool = True,
         data=data,
         allreduce=AllReduceConfig(**ar_kw),
         optimizer_sharding=(getattr(args, "optimizer_sharding", None)
-                            or "none"))
+                            or "none"),
+        overlap_collectives=getattr(args, "overlap_collectives", True),
+        opt_state_offload=getattr(args, "opt_state_offload", False))
 
     quick_w = (args.warmup_steps if args.warmup_steps is not None
                else args.quick_warmup)
@@ -374,13 +392,18 @@ def _child_measure(args, emit_quick: bool = True,
     _note(f"compile+warmup({quick_w}) done in "
           f"{time.perf_counter() - t_compile:.1f}s; quick window starts")
     # Per-device memory annotation for every metric line this row emits:
-    # peak HBM where the allocator reports it, plus params/opt-state
-    # resident bytes (shard-aware) — the numbers the ZeRO-1 A/B compares.
+    # peak HBM where the allocator reports it, plus params/grads/opt-state
+    # resident bytes (shard-aware) and their sum — the numbers the ZeRO
+    # ladder rows compare (replicated -> zero1 -> zero2 -> zero3 must fall
+    # monotonically).
     mem = {}
     try:
-        stats = loop._device_memory_stats(state)
+        stats = loop._device_memory_stats(state, train_step)
         for key in ("peak_bytes_in_use", "bytes_in_use",
-                    "params_bytes_per_device", "opt_state_bytes_per_device"):
+                    "params_bytes_per_device", "grads_bytes_per_device",
+                    "opt_state_bytes_per_device",
+                    "ema_params_bytes_per_device",
+                    "resident_bytes_per_device"):
             if key in stats:
                 mem[key] = int(stats[key])
     except Exception:
@@ -612,6 +635,7 @@ def _child(args) -> int:
         row.fused_block = row.fused_conv3 = False
         row.allreduce_bucket_mb = row.allreduce_dtype = None
         row.optimizer_sharding = None
+        row.overlap_collectives, row.opt_state_offload = True, False
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -996,11 +1020,23 @@ def main(argv=None) -> int:
                    help="gradient all-reduce payload dtype (bfloat16 = "
                         "compressed wire payload, fp32 restored after)")
     p.add_argument("--optimizer-sharding", default=None,
-                   choices=[None, "none", "zero1"],
-                   help="ZeRO-1 optimizer-state sharding (parallel/zero.py): "
-                        "reduce-scatter grads, update 1/N of the params per "
-                        "chip, all-gather; emitted under its own _zero1 "
-                        "metric name; unset = replicated optimizer")
+                   choices=[None, "none", "zero1", "zero2", "zero3"],
+                   help="ZeRO sharding ladder (parallel/zero.py): zero1 = "
+                        "sharded optimizer state, zero2 = + grads stay "
+                        "reduce-scattered per bucket, zero3 = + params "
+                        "1/N-chunked, all-gathered per bucket; each stage "
+                        "emitted under its own _<stage> metric name; unset "
+                        "= replicated optimizer")
+    p.add_argument("--no-overlap-collectives", dest="overlap_collectives",
+                   action="store_false", default=True,
+                   help="serialize the zero2/zero3 reduce-scatters after "
+                        "backward instead of issuing them per fusion "
+                        "bucket as cotangents are produced (A/B for the "
+                        "overlap win; marked no-overlap in the protocol)")
+    p.add_argument("--opt-state-offload", action="store_true",
+                   help="place sharded optimizer-state chunks in host RAM "
+                        "(pinned_host memory kind) where the backend "
+                        "exposes it; no-op with a warning elsewhere")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--quick-steps", type=int, default=8,
                    help="timed steps in the progressive quick window")
@@ -1177,6 +1213,10 @@ def main(argv=None) -> int:
         child_cmd += ["--allreduce-dtype", args.allreduce_dtype]
     if args.optimizer_sharding:
         child_cmd += ["--optimizer-sharding", args.optimizer_sharding]
+    if not args.overlap_collectives:
+        child_cmd += ["--no-overlap-collectives"]
+    if args.opt_state_offload:
+        child_cmd += ["--opt-state-offload"]
     if args.trace_dir:
         child_cmd += ["--trace-dir", args.trace_dir]
     if args.compile_cache_dir is not None:

@@ -214,14 +214,31 @@ class TrainConfig:
                                   # requires fused_block
     sync_bn: bool = False         # cross-replica BN statistics (psum over
                                   # the data axis; torch SyncBatchNorm)
-    optimizer_sharding: str = "none"  # none | zero1 (explicit-DP path only):
-                                  # ZeRO-1 — reduce-scatter grads, update
-                                  # each shard's 1/N param chunk against
-                                  # permanently sharded optimizer state,
-                                  # all-gather updated params. Same comm
-                                  # volume as the ring all-reduce, optimizer
-                                  # HBM / update FLOPs divided by the DP
-                                  # degree (parallel/zero.py)
+    optimizer_sharding: str = "none"  # none | zero1 | zero2 | zero3
+                                  # (explicit-DP path only) — the ZeRO
+                                  # ladder (parallel/zero.py): zero1 shards
+                                  # optimizer state 1/N (reduce-scatter
+                                  # grads, chunk update, all-gather updated
+                                  # params); zero2 additionally never
+                                  # materializes the full gradient tree
+                                  # (grads born reduce-scattered during
+                                  # backward, same update math as zero1);
+                                  # zero3 additionally keeps the parameters
+                                  # themselves 1/N-sharded, all-gathered
+                                  # on demand per fusion bucket
+    overlap_collectives: bool = True  # zero2/zero3 only: issue each fusion
+                                  # bucket's gradient reduce-scatter inside
+                                  # backward as its cotangents complete
+                                  # (custom_vjp bucket boundaries) instead
+                                  # of one serialized pass after backward.
+                                  # Off = A/B baseline; update math is
+                                  # unchanged either way
+    opt_state_offload: bool = False  # place the sharded optimizer-state
+                                  # chunks in host RAM (pinned_host memory
+                                  # kind) instead of HBM. Needs runtime
+                                  # support (TPU); silently-loud no-op
+                                  # fallback elsewhere (docs/
+                                  # zero_sharding.md caveats)
     compile_cache_dir: Optional[str] = None  # persistent compile cache + AOT
                                   # step executables (perf/compile_cache.py):
                                   # None = $DDL_COMPILE_CACHE, else the

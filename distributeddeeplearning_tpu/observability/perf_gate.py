@@ -61,6 +61,19 @@ WORKLOAD = {
     "steps": 10,
     "warmup": 3,
 }
+# Named gate workloads. "default" is the headline proxy above (top level
+# of perf_baselines.json); the rest live under the file's "extras" key and
+# gate specific schedules. ``zero2_overlap`` drives the overlapped ZeRO-2
+# path on a dp=2 CPU mesh — the custom_vjp bucket boundaries, per-bucket
+# reduce-scatter, and chunked update all sit inside its timed step, so a
+# retrace or added sync in the sharded schedule fails tier-1 here instead
+# of waiting for chip time. Fewer steps than the default: the sharded
+# step is slower per step and the gate needs a median, not a mean.
+WORKLOADS = {
+    "default": WORKLOAD,
+    "zero2_overlap": dict(WORKLOAD, steps=6, dp=2,
+                          optimizer_sharding="zero2"),
+}
 # LR-schedule horizon compiled into the step program; fixed so every
 # measure() pass (and the AOT cache) shares one executable.
 _TOTAL_STEPS = 64
@@ -112,13 +125,18 @@ class ProxyRunner:
         from distributeddeeplearning_tpu.train import loop
 
         w = self.workload
+        # Optional workload keys: ``dp`` widens the CPU mesh (needs
+        # --xla_force_host_platform_device_count >= dp, as tests/conftest.py
+        # and tools/perf_gate.py both force), ``optimizer_sharding`` selects
+        # a ZeRO stage — how the zero2_overlap gate workload exists.
         self.config = TrainConfig(
             model=w["model"], backend="cpu",
             global_batch_size=w["batch"], dtype=w["dtype"],
             seed=w["seed"], log_every=10**9,
+            optimizer_sharding=w.get("optimizer_sharding", "none"),
             data=DataConfig(synthetic=True, image_size=w["image_size"],
                             num_classes=10),
-            parallel=ParallelConfig(data=1))
+            parallel=ParallelConfig(data=w.get("dp", 1)))
         spec = model_spec(w["model"])
         (self.mesh, self.model, batch_shd, self.state, self.train_step,
          _sched, self.rng) = loop.build(self.config, _TOTAL_STEPS)
@@ -191,13 +209,22 @@ def measure(runner: Optional[ProxyRunner] = None, **kw) -> dict:
     return (runner or ProxyRunner()).measure(**kw)
 
 
-def load_baseline(path: Optional[str] = None) -> Optional[dict]:
+def load_baseline(path: Optional[str] = None,
+                  name: str = "default") -> Optional[dict]:
+    """Baseline for a named gate workload: the file's top level for
+    "default", the matching ``extras`` entry otherwise (None = not yet
+    recalibrated for that workload)."""
     try:
         with open(path or BASELINE_PATH) as fh:
             obj = json.load(fh)
-        return obj if isinstance(obj, dict) else None
     except (OSError, ValueError):
         return None
+    if not isinstance(obj, dict):
+        return None
+    if name == "default":
+        return obj
+    extra = (obj.get("extras") or {}).get(name)
+    return extra if isinstance(extra, dict) else None
 
 
 def compare(baseline: Optional[dict], current: dict,
@@ -207,7 +234,8 @@ def compare(baseline: Optional[dict], current: dict,
     loosening the gate is a reviewed diff, not a test-local constant."""
     if not baseline:
         return ["no baseline: run `python tools/perf_gate.py "
-                "--recalibrate` and commit perf_baselines.json"]
+                "--recalibrate [--workload NAME]` and commit "
+                "perf_baselines.json"]
     tol = dict(DEFAULT_TOLERANCE, **(baseline.get("tolerance") or {}),
                **(tolerance or {}))
     out = []
@@ -252,16 +280,22 @@ def _write_sidecar(result: dict) -> None:
 def check(baseline_path: Optional[str] = None,
           runner: Optional[ProxyRunner] = None,
           inject_sleep_s: float = 0.0,
-          write_sidecar: bool = True) -> dict:
-    """Measure the proxy and gate it against the checked-in baseline.
-    Returns ``{ok, violations, current, baseline}``; also drops the
-    result into ``.cache/perf_gate_last.json`` for tools/doctor.py."""
-    baseline = load_baseline(baseline_path)
+          write_sidecar: bool = True,
+          workload: str = "default") -> dict:
+    """Measure the named proxy workload and gate it against its checked-in
+    baseline. Returns ``{ok, violations, current, baseline}``; the default
+    workload also drops the result into ``.cache/perf_gate_last.json`` for
+    tools/doctor.py (extras never overwrite the headline sidecar)."""
+    baseline = load_baseline(baseline_path, name=workload)
+    if runner is None:
+        runner = ProxyRunner(None if workload == "default"
+                             else WORKLOADS[workload])
     current = measure(runner, inject_sleep_s=inject_sleep_s)
     violations = compare(baseline, current)
     result: dict[str, Any] = {
         "ok": not violations,
         "violations": violations,
+        "workload_name": workload,
         "current": current,
         "baseline_normalized_step": (baseline or {}).get("normalized_step"),
         "baseline_recorded": (baseline or {}).get("recorded"),
@@ -270,7 +304,7 @@ def check(baseline_path: Optional[str] = None,
     rev = perf_report.git_rev()
     if rev:
         result["git_rev"] = rev
-    if write_sidecar and inject_sleep_s == 0:
+    if write_sidecar and inject_sleep_s == 0 and workload == "default":
         # Never persist a deliberately-slowed self-test pass as "the
         # last gate result" — doctor would report a phantom regression.
         _write_sidecar(result)
@@ -279,17 +313,21 @@ def check(baseline_path: Optional[str] = None,
 
 def recalibrate(path: Optional[str] = None,
                 runner: Optional[ProxyRunner] = None,
-                passes: int = 3) -> dict:
+                passes: int = 3,
+                workload: str = "default") -> dict:
     """Measure ``passes`` times, keep the fastest pass (baseline = the
     machine's honest capability, not its worst moment), and write the
-    baseline file. Returns the baseline written."""
-    r = runner or ProxyRunner()
+    baseline file. Recalibrating "default" rewrites the top level but
+    PRESERVES any ``extras`` entries; recalibrating a named extra rewrites
+    only its entry under ``extras``. Returns the baseline entry written."""
+    r = runner or ProxyRunner(None if workload == "default"
+                              else WORKLOADS[workload])
     best = None
     for _ in range(max(passes, 1)):
         cur = r.measure()
         if best is None or cur["normalized_step"] < best["normalized_step"]:
             best = cur
-    baseline = {
+    entry = {
         "schema_version": SCHEMA_VERSION,
         "workload": best["workload"],
         "step_time_ms": best["step_time_ms"],
@@ -304,12 +342,32 @@ def recalibrate(path: Optional[str] = None,
         },
     }
     out = path or BASELINE_PATH
+    existing = None
+    try:
+        with open(out) as fh:
+            existing = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    if not isinstance(existing, dict):
+        existing = None
+    if workload == "default":
+        baseline = dict(entry)
+        if existing and isinstance(existing.get("extras"), dict):
+            baseline["extras"] = existing["extras"]
+    else:
+        if existing is None:
+            raise ValueError(
+                f"cannot recalibrate extra workload {workload!r} into a "
+                f"missing/invalid baseline file {out!r}: recalibrate the "
+                f"default workload first")
+        baseline = existing
+        baseline.setdefault("extras", {})[workload] = entry
     tmp = f"{out}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(baseline, fh, indent=2, sort_keys=True)
         fh.write("\n")
     os.replace(tmp, out)
-    return baseline
+    return entry
 
 
 def status(baseline_path: Optional[str] = None) -> dict:
@@ -321,6 +379,7 @@ def status(baseline_path: Optional[str] = None) -> dict:
         out["baseline_normalized_step"] = baseline.get("normalized_step")
         out["baseline_recorded"] = baseline.get("recorded", {})
         out["tolerance"] = baseline.get("tolerance", {})
+        out["extra_baselines"] = sorted((baseline.get("extras") or {}))
     try:
         with open(LAST_RESULT_PATH) as fh:
             last = json.load(fh)

@@ -351,3 +351,26 @@ def phase_totals(events) -> dict[str, dict[str, float]]:
                      "total_ms": total_us / 1000.0,
                      "mean_ms": total_us / len(durs) / 1000.0}
     return out
+
+
+def overlap_fraction(events) -> float:
+    """Fraction of gradient reduce-scatter spans issued INSIDE backward.
+
+    The ZeRO bucket scatters (parallel/zero.py) emit trace-time spans named
+    ``collective:<stage>/reduce_scatter/bucketNN``; the custom_vjp backward
+    rules of the overlapped zero2/zero3 schedules mark theirs with
+    ``args.overlapped`` while the serialized post-backward pass (zero1,
+    overlap off) does not. The ratio is therefore the structural
+    backward/collective-overlap fraction of the traced program: 1.0 when
+    every bucket's scatter can run concurrently with remaining backward
+    compute, 0.0 for a fully serialized schedule — or when no scatter spans
+    exist at all (no sharding, or an AOT cache hit that skipped tracing).
+    """
+    total = overlapped = 0
+    for e in events:
+        if e.get("ph") != "X" or "/reduce_scatter/" not in e.get("name", ""):
+            continue
+        total += 1
+        if (e.get("args") or {}).get("overlapped"):
+            overlapped += 1
+    return overlapped / total if total else 0.0

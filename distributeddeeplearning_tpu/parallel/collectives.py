@@ -29,6 +29,12 @@ PAPERS.md:6). This module is the XLA-native port of that idea for the
 Per-leaf reduction (``bucket_bytes=0``) is kept as the A/B reference path —
 bench.py's ``ar_fused`` vs ``ar_perleaf`` suite rows measure exactly this
 module's win on chip.
+
+The bucket independence noted above is ALSO what the ZeRO-2/3 overlapped
+schedules (parallel/zero.py) exploit: each fusion bucket gets its own
+``custom_vjp`` boundary so its reduce-scatter depends only on that bucket's
+cotangents, letting XLA issue it while earlier layers' backward is still
+running.
 """
 
 from __future__ import annotations

@@ -25,6 +25,10 @@ def logical_rules(parallel: ParallelConfig) -> tuple[tuple[str, Any], ...]:
     - ``seq`` → "seq": sequence/context parallelism over activations.
     - ``heads``/``mlp``/``vocab`` → "model": Megatron-style TP.
     - ``embed`` → "fsdp": parameter sharding when fsdp>1, else replicated.
+      (On the explicit-DP path, ``--optimizer-sharding zero3`` subsumes this
+      rule: params live 1/N-chunked in the ZeRO layout and are all-gathered
+      per fusion bucket, so fsdp>1 alone no longer forces GSPMD — see
+      ``loop.uses_gspmd``.)
     - ``experts`` → "expert": MoE expert parallelism (models/moe.py) — the
       dispatch/combine einsums become XLA all-to-alls over ICI.
     - ``layers`` → "pipeline": stage-stacked layer params (parallel/pipeline.py).

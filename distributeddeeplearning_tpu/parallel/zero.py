@@ -1,4 +1,4 @@
-"""ZeRO-1 sharded optimizer layout for the explicit-DP path.
+"""ZeRO sharding ladder (stages 1-3) for the explicit-DP path.
 
 The bucketed ring all-reduce (parallel/collectives.py) already materializes
 the ZeRO-1 partition as its intermediate: after ``psum_scatter`` each shard
@@ -10,6 +10,26 @@ permanently 1/N-sharded, and the ``all_gather`` moves the *updated
 parameters* rather than the summed gradients — identical communication
 volume (one reduce-scatter + one all-gather of the parameter bytes per
 step), optimizer HBM and update FLOPs divided by the DP degree.
+
+The higher stages extend the SAME chunk layout (train/steps.py selects the
+schedule per ``TrainConfig.optimizer_sharding``):
+
+- **ZeRO-2** — gradients are born reduce-scattered: a per-bucket identity
+  ``custom_vjp`` (:func:`assemble_params_overlapped`) makes the loss
+  differentiate w.r.t. this shard's parameter CHUNKS, its backward rule
+  reduce-scattering each bucket's parameter cotangents the moment backward
+  produces them. The full gradient tree is never materialized as a live
+  whole and the collectives overlap the remaining backward compute —
+  update arithmetic identical to zero1 (same packed per-bucket
+  ``psum_scatter``, same chunk update).
+- **ZeRO-3 / FSDP-unified** — parameters themselves live 1/N-chunked and
+  are all-gathered on demand per fusion bucket for forward/backward
+  (:func:`gather_params_overlapped`); the backward rule of that gather is
+  the bucket reduce-scatter, so gradient chunks come out of autodiff
+  already reduced, overlapped with backward. This folds the GSPMD
+  ``fsdp`` parameter-sharding rule (parallel/sharding.py) into the
+  explicit path's bucket planner — an image config with ``fsdp > 1`` plus
+  ``zero3`` shards chunks over BOTH dp axes.
 
 Layout: per-leaf chunking that PRESERVES the parameter treedef. Every leaf
 is raveled, zero-padded to a multiple of the axis size N, and split into N
@@ -40,6 +60,7 @@ and resume (the pad is a function of N and is never persisted).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -93,6 +114,19 @@ def build_layout(tree, axis_size: int,
                        chunk_sizes=chunk_sizes)
 
 
+def payload_dtype_from_options(options=None) -> Optional[Any]:
+    """Gradient-scatter payload dtype per the run's AllReduceConfig (None =
+    reduce in the gradients' own dtype, ``jnp.bfloat16`` = compressed
+    wire payload). Shared by every stage's scatter path."""
+    dtype_name = getattr(options, "dtype", "float32") or "float32"
+    if dtype_name not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"allreduce dtype {dtype_name!r} not supported; use 'float32' "
+            f"(reduce in the gradients' own dtype) or 'bfloat16' "
+            f"(compressed payload, fp32 master restored after the reduce)")
+    return jnp.bfloat16 if dtype_name == "bfloat16" else None
+
+
 def layout_from_options(tree, axis_size: int, options=None
                         ) -> tuple[Zero1Layout, Optional[Any]]:
     """(layout, scatter payload dtype) per the run's AllReduceConfig —
@@ -100,15 +134,24 @@ def layout_from_options(tree, axis_size: int, options=None
     The payload dtype applies to the gradient reduce-scatter only; the
     parameter all-gather always moves the parameters' own dtype."""
     bucket_mb = getattr(options, "bucket_mb", DEFAULT_BUCKET_MB)
-    dtype_name = getattr(options, "dtype", "float32") or "float32"
-    if dtype_name not in ("float32", "bfloat16"):
-        raise ValueError(
-            f"allreduce dtype {dtype_name!r} not supported; use 'float32' "
-            f"(reduce in the gradients' own dtype) or 'bfloat16' "
-            f"(compressed payload, fp32 master restored after the reduce)")
-    payload = jnp.bfloat16 if dtype_name == "bfloat16" else None
+    payload = payload_dtype_from_options(options)
     return build_layout(tree, axis_size,
                         int(float(bucket_mb) * _MB)), payload
+
+
+def modeled_grad_bytes(layout: Zero1Layout, *, chunked: bool) -> int:
+    """Per-device gradient residency MODEL for the memory-ladder accounting
+    (gradients are transient, so unlike params/opt-state they cannot be
+    measured off a held state tree): full leaf bytes for schedules that
+    materialize the whole gradient tree (replicated, zero1, overlap-off
+    zero2/zero3), chunk bytes when gradients only ever exist
+    reduce-scattered (overlapped zero2/zero3)."""
+    plan = layout.plan
+    if chunked:
+        return sum(c * jnp.dtype(plan.dtypes[i]).itemsize
+                   for i, c in enumerate(layout.chunk_sizes))
+    return sum(_numel(s) * jnp.dtype(plan.dtypes[i]).itemsize
+               for i, s in enumerate(plan.shapes))
 
 
 def _check_leaves(layout: Zero1Layout, n: int) -> None:
@@ -177,84 +220,231 @@ def local_chunks(tree, layout: Zero1Layout, axis_names: AxisNames):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _scatter_members(fulls, layout: Zero1Layout, axis_names: AxisNames,
+                     b: int, payload_dtype=None, scope_prefix: str = "zero1",
+                     overlapped: bool = False) -> tuple:
+    """One bucket's reduce-scatter: full-shaped member leaves (ordered as
+    ``layout.plan.buckets[b]``) -> that bucket's reduced chunk leaves.
+
+    The bucket's members are packed as an ``(N, row)`` matrix whose row k
+    holds every member's chunk k, so the tiled ``psum_scatter`` over the
+    raveled payload hands shard k exactly row k — its own chunk of every
+    member — already reduced. ``overlapped=True`` marks the trace-time
+    span for :func:`telemetry.overlap_fraction` — it is set only by the
+    custom_vjp backward rules, where the scatter is issued inside backward.
+    """
+    members = layout.plan.buckets[b]
+    n = layout.axis_size
+    tele = telemetry.get()
+    # Same per-bucket annotation scheme as collectives.all_reduce:
+    # named_scope for device profiles, a trace-time telemetry span
+    # (cat="trace") for the Chrome trace.
+    scope = f"{scope_prefix}/reduce_scatter/bucket{b:02d}"
+    span_args = {"cat": "trace", "leaves": len(members)}
+    if overlapped:
+        span_args["overlapped"] = True
+    with tele.span(f"collective:{scope}", **span_args), \
+            jax.named_scope(scope):
+        common = (jnp.dtype(payload_dtype) if payload_dtype is not None
+                  else jnp.result_type(
+                      *(layout.plan.dtypes[i] for i in members)))
+        parts = []
+        for j, i in enumerate(members):
+            flat = _pad_flat(fulls[j].astype(common), layout.padded_size(i))
+            parts.append(flat.reshape(n, layout.chunk_sizes[i]))
+        row = (parts[0] if len(parts) == 1
+               else jnp.concatenate(parts, axis=1))
+        chunk = jax.lax.psum_scatter(row.reshape(-1), axis_names,
+                                     scatter_dimension=0, tiled=True)
+        out = []
+        off = 0
+        for i in members:
+            c = layout.chunk_sizes[i]
+            piece = jax.lax.dynamic_slice_in_dim(chunk, off, c, 0)
+            out.append(piece.astype(layout.plan.dtypes[i]))
+            off += c
+    return tuple(out)
+
+
+def _gather_members(chunks, layout: Zero1Layout, axis_names: AxisNames,
+                    b: int, scope_prefix: str = "zero1") -> tuple:
+    """One bucket's all-gather: chunk member leaves (ordered as
+    ``layout.plan.buckets[b]``) -> full-shaped member leaves. The gathered
+    ``(N*row,)`` payload reshapes to ``(N, row)`` with row k = shard k's
+    chunks; slicing a member's column block and raveling row-major
+    restores its padded flat leaf in natural order."""
+    members = layout.plan.buckets[b]
+    n = layout.axis_size
+    tele = telemetry.get()
+    scope = f"{scope_prefix}/all_gather/bucket{b:02d}"
+    with tele.span(f"collective:{scope}", cat="trace",
+                   leaves=len(members)), jax.named_scope(scope):
+        common = jnp.result_type(*(layout.plan.dtypes[i] for i in members))
+        parts = [chunks[j].astype(common) for j in range(len(members))]
+        row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        full = jax.lax.all_gather(row, axis_names, tiled=True)
+        mat = full.reshape(n, -1)
+        out = []
+        off = 0
+        for i in members:
+            c = layout.chunk_sizes[i]
+            shape = layout.plan.shapes[i]
+            piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
+            out.append(piece.reshape(n * c)[:_numel(shape)]
+                       .reshape(shape).astype(layout.plan.dtypes[i]))
+            off += c
+    return tuple(out)
+
+
 def reduce_scatter(tree, layout: Zero1Layout, axis_names: AxisNames, *,
                    payload_dtype=None):
     """Cross-shard SUM of every leaf, each shard keeping only its chunk.
 
-    One ``psum_scatter`` per fusion bucket: the bucket's member leaves are
-    packed as an ``(N, row)`` matrix whose row k holds every member's chunk
-    k, so the tiled scatter over the raveled payload hands shard k exactly
-    row k — its own chunk of every member — already reduced. This is the
-    first half of the ring all-reduce with the all-gather elided.
+    One ``psum_scatter`` per fusion bucket (see :func:`_scatter_members`) —
+    the first half of the ring all-reduce with the all-gather elided.
 
     ``payload_dtype`` (bf16 compression) applies to the scatter payload
     only; chunks are restored to each leaf's own dtype immediately after.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     _check_leaves(layout, len(leaves))
-    n = layout.axis_size
     out: list[Any] = [None] * len(leaves)
-    tele = telemetry.get()
     for b, members in enumerate(layout.plan.buckets):
-        # Same per-bucket annotation scheme as collectives.all_reduce:
-        # named_scope for device profiles, a trace-time telemetry span
-        # (cat="trace") for the Chrome trace.
-        scope = f"zero1/reduce_scatter/bucket{b:02d}"
-        with tele.span(f"collective:{scope}", cat="trace",
-                       leaves=len(members)), jax.named_scope(scope):
-            common = (jnp.dtype(payload_dtype) if payload_dtype is not None
-                      else jnp.result_type(
-                          *(layout.plan.dtypes[i] for i in members)))
-            parts = []
-            for i in members:
-                flat = _pad_flat(leaves[i].astype(common),
-                                 layout.padded_size(i))
-                parts.append(flat.reshape(n, layout.chunk_sizes[i]))
-            row = (parts[0] if len(parts) == 1
-                   else jnp.concatenate(parts, axis=1))
-            chunk = jax.lax.psum_scatter(row.reshape(-1), axis_names,
-                                         scatter_dimension=0, tiled=True)
-            off = 0
-            for i in members:
-                c = layout.chunk_sizes[i]
-                piece = jax.lax.dynamic_slice_in_dim(chunk, off, c, 0)
-                out[i] = piece.astype(layout.plan.dtypes[i])
-                off += c
+        pieces = _scatter_members([leaves[i] for i in members], layout,
+                                  axis_names, b, payload_dtype)
+        for i, piece in zip(members, pieces):
+            out[i] = piece
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames):
     """Reassemble full leaves from per-shard chunks (updated parameters).
 
-    One ``all_gather`` per fusion bucket — the second half of the ring
-    all-reduce, moved AFTER the optimizer update. The gathered ``(N*row,)``
-    payload reshapes to ``(N, row)`` with row k = shard k's chunks; slicing
-    a member's column block and raveling row-major restores its padded flat
-    leaf in natural order.
+    One ``all_gather`` per fusion bucket (see :func:`_gather_members`) —
+    the second half of the ring all-reduce, moved AFTER the optimizer
+    update.
     """
     leaves, treedef = jax.tree_util.tree_flatten(chunks)
     _check_leaves(layout, len(leaves))
-    n = layout.axis_size
     out: list[Any] = [None] * len(leaves)
-    tele = telemetry.get()
     for b, members in enumerate(layout.plan.buckets):
-        scope = f"zero1/all_gather/bucket{b:02d}"
-        with tele.span(f"collective:{scope}", cat="trace",
-                       leaves=len(members)), jax.named_scope(scope):
-            common = jnp.result_type(
-                *(layout.plan.dtypes[i] for i in members))
-            parts = [leaves[i].astype(common) for i in members]
-            row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            full = jax.lax.all_gather(row, axis_names, tiled=True)
-            mat = full.reshape(n, -1)
-            off = 0
-            for i in members:
-                c = layout.chunk_sizes[i]
-                shape = layout.plan.shapes[i]
-                piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
-                out[i] = (piece.reshape(n * c)[:_numel(shape)]
-                          .reshape(shape).astype(layout.plan.dtypes[i]))
-                off += c
+        pieces = _gather_members([leaves[i] for i in members], layout,
+                                 axis_names, b)
+        for i, piece in zip(members, pieces):
+            out[i] = piece
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Backward/collective overlap (ZeRO-2/3). Each fusion bucket gets its OWN
+# custom_vjp boundary, so in the backward pass bucket b's reduce-scatter
+# depends only on bucket b's parameter cotangents — XLA issues it the moment
+# those are produced, while backward continues through earlier layers. A
+# single tree-level vjp (or the post-backward reduce_scatter above) would
+# serialize every collective after the last cotangent instead.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _gather_vjp(layout: Zero1Layout, axis_names, b: int, payload_dtype,
+                scope_prefix: str):
+    """ZeRO-3 bucket primitive: fwd all-gathers this shard's chunks into
+    full leaves; bwd reduce-scatters the full-shaped cotangents back to
+    chunk cotangents (the exact transpose of a tiled all-gather whose
+    output feeds every shard's loss term)."""
+
+    def _primal(*chunks):
+        return _gather_members(chunks, layout, axis_names, b, scope_prefix)
+
+    def _fwd(*chunks):
+        return _primal(*chunks), None
+
+    def _bwd(_, cts):
+        return _scatter_members(cts, layout, axis_names, b, payload_dtype,
+                                scope_prefix, overlapped=True)
+
+    fn = jax.custom_vjp(_primal)
+    fn.defvjp(_fwd, _bwd)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _assemble_vjp(layout: Zero1Layout, axis_names, b: int, payload_dtype):
+    """ZeRO-2 bucket primitive: fwd is the IDENTITY on the already-
+    replicated full leaves (the chunk operands are unused — parameters are
+    not sharded at stage 2, so no forward gather is owed); bwd
+    reduce-scatters the full-shaped cotangents into the CHUNK operands'
+    cotangent slots. Differentiating a loss w.r.t. the chunks therefore
+    yields already-reduce-scattered gradients without the full gradient
+    tree ever forming, at zero forward cost. The full-leaf operands get
+    zero cotangents — they enter as non-differentiated closure constants
+    in train/steps.py, so those zeros are dead code XLA eliminates."""
+    members = layout.plan.buckets[b]
+    nm = len(members)
+
+    def _primal(*args):
+        return args[:nm]
+
+    def _fwd(*args):
+        return args[:nm], None
+
+    def _bwd(_, cts):
+        gchunks = _scatter_members(cts, layout, axis_names, b, payload_dtype,
+                                   "zero2", overlapped=True)
+        zeros = tuple(jnp.zeros(layout.plan.shapes[i],
+                                layout.plan.dtypes[i]) for i in members)
+        return zeros + gchunks
+
+    fn = jax.custom_vjp(_primal)
+    fn.defvjp(_fwd, _bwd)
+    return fn
+
+
+def _as_axis_key(axis_names: AxisNames):
+    return axis_names if isinstance(axis_names, str) else tuple(axis_names)
+
+
+def gather_params_overlapped(pchunks, layout: Zero1Layout,
+                             axis_names: AxisNames, *, payload_dtype=None,
+                             scope_prefix: str = "zero3"):
+    """ZeRO-3 on-demand parameter materialization with backward overlap.
+
+    Assembles the full parameter tree from this shard's chunk tree, one
+    custom_vjp all-gather per fusion bucket. Differentiating a loss through
+    the result w.r.t. ``pchunks`` yields ALREADY reduce-scattered chunk
+    gradients (cross-shard SUM — divide by N for the average), each
+    bucket's scatter issued inside backward as its cotangents complete.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(pchunks)
+    _check_leaves(layout, len(leaves))
+    out: list[Any] = [None] * len(leaves)
+    key = _as_axis_key(axis_names)
+    for b, members in enumerate(layout.plan.buckets):
+        fn = _gather_vjp(layout, key, b, payload_dtype, scope_prefix)
+        fulls = fn(*[leaves[i] for i in members])
+        for i, full in zip(members, fulls):
+            out[i] = full
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def assemble_params_overlapped(params, pchunks, layout: Zero1Layout,
+                               axis_names: AxisNames, *, payload_dtype=None):
+    """ZeRO-2 gradient-scatter boundary: returns ``params`` unchanged
+    (identity forward — parameters stay replicated at stage 2) wired so
+    that differentiating a loss through the result w.r.t. ``pchunks``
+    yields reduce-scattered bucket gradients issued during backward.
+    ``params`` must enter as a non-differentiated constant of the loss."""
+    pleaves, treedef = jax.tree_util.tree_flatten(params)
+    cleaves, _ = jax.tree_util.tree_flatten(pchunks)
+    _check_leaves(layout, len(pleaves))
+    _check_leaves(layout, len(cleaves))
+    out: list[Any] = [None] * len(pleaves)
+    key = _as_axis_key(axis_names)
+    for b, members in enumerate(layout.plan.buckets):
+        fn = _assemble_vjp(layout, key, b, payload_dtype)
+        fulls = fn(*([pleaves[i] for i in members]
+                     + [cleaves[i] for i in members]))
+        for i, full in zip(members, fulls):
+            out[i] = full
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -313,26 +503,46 @@ def opt_state_specs(tx, params_struct, layout: Zero1Layout,
         treedef, [chunk_spec if m else replicated_spec for m in mask])
 
 
-class Zero1StateConverter:
-    """Gather-on-save / reshard-on-restore for the chunked optimizer state.
+class ZeroStateConverter:
+    """Gather-on-save / reshard-on-restore between a stage's live layout
+    and the CANONICAL (replicated-path) checkpoint layout.
 
-    ``to_canonical`` strips padding and restores each chunked opt-state
-    leaf to its parameter's shape — the exact layout the replicated path
-    saves, so checkpoints are interchangeable between ``none`` and
-    ``zero1`` and across DP degrees. ``from_canonical`` re-pads for the
-    CURRENT layout and places chunk leaves sharded over the DP axes.
-    ``canonical_abstract`` describes the on-disk layout for orbax's
-    structure-matched restore (replicated placement; the reshard happens in
-    ``from_canonical`` right after).
+    ``to_canonical`` strips padding and restores each chunked leaf to its
+    parameter's shape — the exact layout the replicated path saves, so
+    checkpoints are interchangeable across ``none``/``zero1``/``zero2``/
+    ``zero3`` and across DP degrees (the pad is a function of N and never
+    persisted). ``from_canonical`` re-pads for the CURRENT layout and
+    places chunk leaves sharded over the DP axes. ``canonical_abstract``
+    describes the on-disk layout for orbax's structure-matched restore
+    (replicated placement; the reshard happens in ``from_canonical`` right
+    after).
+
+    ``stage`` selects WHICH trees are chunked in the live layout: the
+    optimizer state for every stage (1-3 share the zero1 opt layout;
+    stage 2's difference — never-materialized gradients — is transient and
+    has no checkpoint footprint), plus params/ema_params at stage 3, where
+    parameters live in the chunked global form.
     """
 
     def __init__(self, tx, params_struct, layout: Zero1Layout, mesh,
-                 axis_names: AxisNames):
+                 axis_names: AxisNames, stage: int = 1,
+                 opt_memory_kind: Optional[str] = None):
+        if stage not in (1, 2, 3):
+            raise ValueError(f"stage must be 1, 2 or 3 (got {stage})")
         self.layout = layout
+        self.stage = stage
+        self.opt_memory_kind = opt_memory_kind
+        self._params_struct = _struct_tree(params_struct)
         self._flat_canon, self._flat_chunk, self._treedef, self._mask = (
             _opt_templates(tx, params_struct, layout))
         self._rep = NamedSharding(mesh, P())
         self._chunk_shd = NamedSharding(mesh, P(axis_names))
+        # Host-RAM offload (--opt-state-offload): the chunked opt-state
+        # leaves carry a host memory kind; params/ema chunk placements
+        # (stage 3) stay in device memory — they're touched every fwd/bwd.
+        self._opt_chunk_shd = (self._chunk_shd.with_memory_kind(
+            opt_memory_kind) if opt_memory_kind else self._chunk_shd)
+        self._full_params_jit = None
 
     def _flat(self, opt_state):
         flat, treedef = jax.tree_util.tree_flatten(opt_state)
@@ -360,24 +570,85 @@ class Zero1StateConverter:
     def opt_shardings(self):
         return jax.tree_util.tree_unflatten(
             self._treedef,
-            [self._chunk_shd if m else self._rep for m in self._mask])
+            [self._opt_chunk_shd if m else self._rep for m in self._mask])
+
+    def param_shardings(self, tree):
+        """Chunk shardings for a params-shaped tree (stage-3 live layout)."""
+        return jax.tree_util.tree_map(lambda _: self._chunk_shd, tree)
+
+    @property
+    def _params_chunked(self) -> bool:
+        return self.stage >= 3
+
+    def _live_to_canonical(self, s):
+        s = s.replace(opt_state=self._opt_to_canonical(s.opt_state))
+        if self._params_chunked:
+            s = s.replace(params=from_chunked(s.params, self.layout))
+            if s.ema_params is not None:
+                s = s.replace(
+                    ema_params=from_chunked(s.ema_params, self.layout))
+        return s
 
     def to_canonical(self, state):
-        """TrainState with the opt state gathered to canonical layout."""
-        return jax.jit(lambda s: s.replace(
-            opt_state=self._opt_to_canonical(s.opt_state)))(state)
+        """TrainState with every chunked tree gathered to canonical layout."""
+        if self._params_chunked:
+            # Pin EVERY output replicated — canonical means full shapes,
+            # opt state included; without out_shardings the pad-strip
+            # reshape could keep a sharded placement that the canonical
+            # (on-disk) layout does not admit.
+            shardings = jax.tree_util.tree_map(lambda _: self._rep, state)
+            return jax.jit(self._live_to_canonical,
+                           out_shardings=shardings)(state)
+        return jax.jit(self._live_to_canonical)(state)
 
     def from_canonical(self, state):
-        """TrainState with the opt state padded + sharded for this layout."""
+        """TrainState re-padded + sharded for this stage's live layout."""
         shardings = jax.tree_util.tree_map(lambda _: self._rep, state)
         shardings = shardings.replace(opt_state=self.opt_shardings())
-        return jax.jit(
-            lambda s: s.replace(
-                opt_state=self._opt_from_canonical(s.opt_state)),
-            out_shardings=shardings)(state)
+        if self._params_chunked:
+            shardings = shardings.replace(
+                params=self.param_shardings(state.params))
+            if state.ema_params is not None:
+                shardings = shardings.replace(
+                    ema_params=self.param_shardings(state.ema_params))
+
+        def _pad(s):
+            s = s.replace(opt_state=self._opt_from_canonical(s.opt_state))
+            if self._params_chunked:
+                s = s.replace(params=to_chunked(s.params, self.layout))
+                if s.ema_params is not None:
+                    s = s.replace(
+                        ema_params=to_chunked(s.ema_params, self.layout))
+            return s
+
+        return jax.jit(_pad, out_shardings=shardings)(state)
+
+    def full_params_state(self, state):
+        """``state`` with FULL-shape (canonical) params/ema for consumers
+        that need the whole model resident — evaluation, export. Identity
+        below stage 3; at stage 3 a cached jit gathers the chunked global
+        form back to parameter shapes (pure reshape+slice: the chunked
+        global form holds every element, just padded and raveled)."""
+        if not self._params_chunked:
+            return state
+        if self._full_params_jit is None:
+            def _full(s):
+                s = s.replace(params=from_chunked(s.params, self.layout))
+                if s.ema_params is not None:
+                    s = s.replace(
+                        ema_params=from_chunked(s.ema_params, self.layout))
+                return s
+            self._full_params_jit = jax.jit(_full)
+        return self._full_params_jit(state)
+
+    def _abstract_full(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape),
+                                           jnp.dtype(x.dtype),
+                                           sharding=self._rep), tree)
 
     def canonical_abstract(self, state_like):
-        """``state_like`` with the opt state replaced by the canonical
+        """``state_like`` with every chunked tree replaced by the canonical
         (on-disk) layout as sharding-carrying ShapeDtypeStructs."""
         out = []
         for leaf, m, canon in zip(self._flat(state_like.opt_state),
@@ -389,5 +660,17 @@ class Zero1StateConverter:
                 out.append(jax.ShapeDtypeStruct(
                     tuple(leaf.shape), leaf.dtype,
                     sharding=getattr(leaf, "sharding", self._rep)))
-        return state_like.replace(opt_state=jax.tree_util.tree_unflatten(
-            self._treedef, out))
+        state_like = state_like.replace(
+            opt_state=jax.tree_util.tree_unflatten(self._treedef, out))
+        if self._params_chunked:
+            state_like = state_like.replace(
+                params=self._abstract_full(self._params_struct))
+            if state_like.ema_params is not None:
+                state_like = state_like.replace(
+                    ema_params=self._abstract_full(self._params_struct))
+        return state_like
+
+
+# Name retained from the ZeRO-1-only era (PR 2); external callers and
+# checkpoints are agnostic to which stage produced a canonical save.
+Zero1StateConverter = ZeroStateConverter

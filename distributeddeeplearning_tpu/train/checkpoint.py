@@ -11,6 +11,12 @@ compiled for.
 Failure semantics (SURVEY.md §5.3): a run that dies is restarted by the
 launcher wrapper and resumes from ``latest_step`` — the fail-whole +
 checkpoint-resume model the reference's mpirun jobs had, minus Batch-AI.
+
+Optimizer-sharded states (any ZeRO stage) are saved through the CANONICAL
+layout: ``zero.ZeroStateConverter`` gathers chunked leaves (opt state at
+every stage; params/ema too at zero3) to replicated full shapes on save and
+re-chunks on restore, so a checkpoint written at one stage/DP-degree resumes
+at any other (tests/test_zero_ladder.py pins the matrix).
 """
 
 from __future__ import annotations

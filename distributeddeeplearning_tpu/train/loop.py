@@ -72,10 +72,32 @@ def steps_per_epoch(config: TrainConfig) -> Optional[int]:
 
 
 def uses_gspmd(config: TrainConfig, input_kind: str) -> bool:
-    """Transformers (or any config with tp/sp/fsdp axes) take the GSPMD path;
-    pure-DP CNNs take the explicit shard_map+psum path."""
+    """Transformers (or any config with tp/sp axes) take the GSPMD path;
+    pure-DP CNNs take the explicit shard_map+psum path. An ``fsdp`` axis
+    alone forces GSPMD *unless* ``optimizer_sharding='zero3'`` — zero3 folds
+    the GSPMD fsdp parameter-sharding rule into the explicit path's bucket
+    planner (parallel/zero.py), chunk-sharding params over BOTH dp axes."""
     p = config.parallel
-    return input_kind == "tokens" or p.model > 1 or p.seq > 1 or p.fsdp > 1
+    if input_kind == "tokens" or p.model > 1 or p.seq > 1:
+        return True
+    return p.fsdp > 1 and config.optimizer_sharding != "zero3"
+
+
+def _host_offload_kind(mesh) -> Optional[str]:
+    """The host memory kind for --opt-state-offload, or None when the
+    runtime can't place arrays there. Requires an addressable pinned_host
+    memory on the mesh devices (TPU runtimes expose it; the CPU backend's
+    default memory IS host RAM, so offload there is meaningless and reports
+    unsupported) plus Sharding.with_memory_kind."""
+    try:
+        dev = next(iter(mesh.devices.flat))
+        kinds = {m.kind for m in dev.addressable_memories()}
+        probe = shardlib.replicated(mesh)
+        if not hasattr(probe, "with_memory_kind"):
+            return None
+    except Exception:
+        return None
+    return "pinned_host" if "pinned_host" in kinds else None
 
 
 def build(config: TrainConfig, total_steps: int):
@@ -85,16 +107,18 @@ def build(config: TrainConfig, total_steps: int):
     checkpoint restore."""
     spec = model_spec(config.model)
     _ = config.per_device_batch  # early, friendly divisibility error
-    if config.optimizer_sharding not in ("none", "zero1"):
+    if config.optimizer_sharding not in ("none", "zero1", "zero2", "zero3"):
         raise ValueError(
             f"unknown optimizer_sharding {config.optimizer_sharding!r}; "
-            f"expected 'none' or 'zero1'")
-    if (config.optimizer_sharding == "zero1"
+            f"expected one of 'none', 'zero1', 'zero2', 'zero3'")
+    if (config.optimizer_sharding != "none"
             and uses_gspmd(config, spec.input_kind)):
         raise ValueError(
-            "optimizer_sharding='zero1' applies to the explicit-DP "
-            "shard_map path only (image model, no tp/sp/fsdp axes); the "
-            "GSPMD path shards state via NamedSharding rules instead")
+            f"optimizer_sharding={config.optimizer_sharding!r} applies to "
+            "the explicit-DP shard_map path only (image model, no tp/sp "
+            "axes — and no fsdp axis except under zero3, which absorbs "
+            "it); the GSPMD path shards state via NamedSharding rules "
+            "instead")
     if config.attention_impl == "flash" and config.parallel.seq > 1:
         raise ValueError(
             "attention_impl='flash' is incompatible with seq-axis "
@@ -169,13 +193,15 @@ def build(config: TrainConfig, total_steps: int):
             f"(e.g. bert_base_moe) whose expert count is divisible by the "
             f"mesh axis")
 
-    zero1 = config.optimizer_sharding == "zero1"
-    # Under ZeRO-1 the optimizer sees 1/N chunks, so its norm-based pieces
-    # (global clip, LARS/LAMB trust ratios) must psum over the DP axes.
+    stage = config.optimizer_sharding
+    sharded = stage in ("zero1", "zero2", "zero3")
+    # Under any ZeRO stage the optimizer sees 1/N chunks, so its norm-based
+    # pieces (global clip, LARS/LAMB trust ratios) must psum over the DP
+    # axes.
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,
         steps_per_epoch(config),
-        shard_axes=steps.DATA_AXES if zero1 else None)
+        shard_axes=steps.DATA_AXES if sharded else None)
     bn_batch = config.per_device_batch // max(config.grad_accum_steps, 1)
     if config.sync_bn:
         # SyncBN pools statistics across the DP shards: the effective
@@ -231,34 +257,55 @@ def build(config: TrainConfig, total_steps: int):
                 train=False)
 
         replicated = shardlib.replicated(mesh)
-        layout = converter = None
-        if zero1:
+        layout = converter = params_struct = None
+        if sharded:
             dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
             params_struct = jax.eval_shape(variables_fn, rng)["params"]
             layout, _ = zerolib.layout_from_options(
                 params_struct, dp_size, options=config.allreduce)
-            converter = zerolib.Zero1StateConverter(
-                tx, params_struct, layout, mesh, steps.DATA_AXES)
+            offload_kind = None
+            if getattr(config, "opt_state_offload", False):
+                offload_kind = _host_offload_kind(mesh)
+                if offload_kind is None and jax.process_index() == 0:
+                    print("# warning: --opt-state-offload requested but "
+                          "this backend exposes no addressable host memory "
+                          "kind (pinned_host) — optimizer state stays in "
+                          "device memory (docs/zero_sharding.md)",
+                          file=sys.stderr, flush=True)
+            converter = zerolib.ZeroStateConverter(
+                tx, params_struct, layout, mesh, steps.DATA_AXES,
+                stage=3 if stage == "zero3" else 1,
+                opt_memory_kind=offload_kind)
 
         def init_fn(rng):
             variables = variables_fn(rng)
             params = variables["params"]
-            # ZeRO-1: optimizer state is born in the chunked global layout
+            # ZeRO: optimizer state is born in the chunked global layout
             # (each leaf padded+raveled to chunk*N); out_shardings below
             # then scatter it 1/N per device — it is never materialized
-            # replicated.
-            opt_params = (zerolib.to_chunked(params, layout) if zero1
+            # replicated. Under zero3 the params (and EMA) themselves are
+            # born in that layout too.
+            opt_params = (zerolib.to_chunked(params, layout) if sharded
                           else params)
+            if stage == "zero3":
+                params = opt_params
             return TrainState.create(
                 params=params, opt_state=tx.init(opt_params),
                 batch_stats=variables.get("batch_stats"),
                 ema_params=(params if config.optimizer.ema_decay > 0
                             else None))
 
-        if zero1:
+        if sharded:
             abstract = jax.eval_shape(init_fn, rng)
             out_shd = jax.tree_util.tree_map(lambda _: replicated, abstract)
             out_shd = out_shd.replace(opt_state=converter.opt_shardings())
+            if stage == "zero3":
+                out_shd = out_shd.replace(
+                    params=converter.param_shardings(abstract.params))
+                if abstract.ema_params is not None:
+                    out_shd = out_shd.replace(
+                        ema_params=converter.param_shardings(
+                            abstract.ema_params))
         else:
             out_shd = replicated
         state = jax.jit(init_fn, out_shardings=out_shd)(rng)
@@ -270,7 +317,8 @@ def build(config: TrainConfig, total_steps: int):
             config, total_steps=total_steps)
         train_step = steps.make_dp_train_step(
             model, tx, mesh, config, spec.input_kind, spec.objective,
-            state_like=state, aot=aot)
+            state_like=state, aot=aot, zero_layout=layout,
+            params_struct=params_struct)
         train_step.zero_converter = converter
         train_step.aot = aot
 
@@ -419,7 +467,11 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
               else f" | allreduce: {config.allreduce.describe()}")
         zl = getattr(train_step, "zero_layout", None)
         if zl is not None:
-            ar += f" | opt-sharding: zero1 ({zl.describe()})"
+            _stage = getattr(train_step, "zero_stage", None) or "zero1"
+            _ov = "+overlap" if getattr(train_step, "overlap", False) else ""
+            _off = ("+offload" if getattr(config, "opt_state_offload", False)
+                    else "")
+            ar += f" | opt-sharding: {_stage}{_ov}{_off} ({zl.describe()})"
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
               f"dtype={config.dtype} loader={resolved_loader}" + ar
@@ -432,6 +484,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     evaluator = None
     eval_every_steps = 0
     evals: list[tuple[int, float]] = []
+    # Under zero3 the live params are chunked; evaluation needs the full
+    # model, so eval consumers go through the converter's cached gather
+    # (identity below stage 3 / without sharding).
+    _zconv = getattr(train_step, "zero_converter", None)
+
+    def _eval_state(st):
+        return _zconv.full_params_state(st) if _zconv is not None else st
+
     if eval_batches > 0:
         if spec.input_kind == "image":
             evaluator = _Evaluator(config, mesh, model, batch_shd,
@@ -448,7 +508,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             # while the first training steps run, so the first
             # epoch-boundary eval doesn't stall the loop on a cold compile.
             evaluator.warm_compile_async(
-                state, aot=getattr(train_step, "aot", None))
+                _eval_state(state), aot=getattr(train_step, "aot", None))
 
     # Fused multi-step blocks (config.steps_per_loop > 1): only when batches
     # are generated on-device (synthetic sources expose gen_fn) — a streaming
@@ -534,6 +594,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     compile_time_s: Optional[float] = None
     time_to_first_step_s: Optional[float] = None
     compile_pending: Optional[float] = None
+    overlap_frac: Optional[float] = None
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
@@ -585,6 +646,17 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                            step=int(i))
                 tele.gauge("time_to_first_step_s",
                            round(time_to_first_step_s, 3), step=int(i))
+                if tele.enabled and getattr(train_step, "zero_stage", None):
+                    # Backward/collective overlap gauge: fraction of the
+                    # step's reduce-scatter spans issued INSIDE backward
+                    # (the custom_vjp bucket boundaries mark theirs
+                    # overlapped=True). Spans are trace-time, so an AOT
+                    # cache hit (zero retraces) leaves no spans and the
+                    # gauge honestly reads 0 — docs/zero_sharding.md.
+                    overlap_frac = telemetry.overlap_fraction(
+                        tele.snapshot())
+                    tele.gauge("backward_collective_overlap",
+                               round(overlap_frac, 4), step=int(i))
             profile.after_step(i - 1, metrics)
             bad_tracker.push(metrics)
             done = i - start_step
@@ -650,7 +722,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     and i < total_steps):
                 t_eval = time.perf_counter()
                 with tele.span("eval", step=int(i)):
-                    val = evaluator(state)
+                    val = evaluator(_eval_state(state))
                 evals.append((i, val))
                 logger.log(int(i), {evaluator.metric_name: val})
                 if t_timed is not None:
@@ -694,11 +766,18 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if compile_time_s is not None:
         summary["compile_time_s"] = round(compile_time_s, 3)
         summary["time_to_first_step_s"] = round(time_to_first_step_s, 3)
+    if getattr(train_step, "zero_stage", None) is not None:
+        summary["optimizer_sharding"] = {
+            "stage": train_step.zero_stage,
+            "overlap": bool(getattr(train_step, "overlap", False)),
+            "overlap_fraction": overlap_frac,
+        }
+    _write_sharding_sidecar(config, train_step, overlap_frac)
     aot = getattr(train_step, "aot", None)
     if aot is not None and aot.enabled:
         summary["compile_cache"] = aot.stats()
         aot.flush_stats()  # counters land next to the cache for doctor.py
-    hbm = _device_memory_stats(state)
+    hbm = _device_memory_stats(state, train_step)
     if hbm:
         summary["memory"] = hbm
         if jax.process_index() == 0:
@@ -707,8 +786,10 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 parts.append(
                     f"peak_hbm={hbm['peak_bytes_in_use'] / 2**20:.1f}MiB")
             for k in ("params_bytes_per_device",
+                      "grads_bytes_per_device",
                       "opt_state_bytes_per_device",
-                      "ema_params_bytes_per_device"):
+                      "ema_params_bytes_per_device",
+                      "resident_bytes_per_device"):
                 if k in hbm:
                     parts.append(f"{k.split('_bytes')[0]}/dev="
                                  f"{hbm[k] / 2**20:.2f}MiB")
@@ -739,7 +820,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     perf_report.annotate(summary, provenance="fresh",
                          config=config, total_steps=total_steps)
     if evaluator is not None:
-        final_val = evaluator(state)
+        final_val = evaluator(_eval_state(state))
         evals.append((end_step, final_val))
         summary[evaluator.metric_name] = final_val
         best = evaluator.best(t for _, t in evals)
@@ -817,14 +898,55 @@ def _record_hbm_gauges(tele, step: int) -> None:
         pass
 
 
-def _device_memory_stats(state=None) -> Optional[dict]:
+def _sharding_sidecar_path() -> str:
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".cache", "last_run_sharding.json")
+
+
+def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
+    """Record the run's active sharding stage + overlap status where
+    tools/doctor.py looks (best-effort, like the compile-cache stats)."""
+    if jax.process_index() != 0:
+        return
+    try:
+        import json
+        import os
+        path = _sharding_sidecar_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        info = {
+            "optimizer_sharding": config.optimizer_sharding,
+            "overlap_collectives": bool(
+                getattr(config, "overlap_collectives", True)),
+            "overlap": bool(getattr(train_step, "overlap", False)),
+            "overlap_fraction": overlap_frac,
+            "opt_state_offload": bool(
+                getattr(config, "opt_state_offload", False)),
+            "dp": config.parallel.data * config.parallel.fsdp,
+            "model": config.model,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(info, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _device_memory_stats(state=None, train_step=None) -> Optional[dict]:
     """Peak/current HBM on local device 0 (where the backend reports it;
     CPU doesn't) plus — given the final ``state`` — the per-device resident
     bytes of params / optimizer state / EMA, computed from the arrays'
-    actual shard placement. The state breakdown works on EVERY backend, so
-    the ZeRO-1 optimizer-memory win is measurable even on the
-    CPU/fake-device path where allocator peaks are unavailable. The
-    observability counterpart of nvidia-smi in the reference's stack."""
+    actual shard placement, and — given the ``train_step`` — the MODELED
+    per-device gradient bytes (zero.modeled_grad_bytes: gradients are
+    transient, so residency is a schedule property, not a measurement).
+    ``resident_bytes_per_device`` sums the components into the per-device
+    memory-ladder number the ZeRO acceptance test asserts decreases
+    replicated→zero1→zero2→zero3. The state breakdown works on EVERY
+    backend, so the win is measurable even on the CPU/fake-device path
+    where allocator peaks are unavailable. The observability counterpart
+    of nvidia-smi in the reference's stack."""
     out: dict = {}
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
@@ -844,6 +966,15 @@ def _device_memory_stats(state=None) -> Optional[dict]:
                         statelib.resident_bytes(tree, dev))
         except Exception:
             pass
+    gb = getattr(train_step, "grad_bytes_per_device", None)
+    if gb is not None:
+        out["grads_bytes_per_device"] = int(gb)
+    resident = [out.get(k) for k in ("params_bytes_per_device",
+                                     "grads_bytes_per_device",
+                                     "opt_state_bytes_per_device",
+                                     "ema_params_bytes_per_device")]
+    if any(v is not None for v in resident):
+        out["resident_bytes_per_device"] = sum(v or 0 for v in resident)
     return out or None
 
 

@@ -10,8 +10,11 @@ Weight decay is masked off BatchNorm/LayerNorm parameters and biases — the
 standard large-batch convention; for LARS the same mask also disables the
 trust-ratio rescaling on those leaves.
 
-ZeRO-1 (``shard_axes``): under optimizer sharding (parallel/zero.py) the
-transformation sees each leaf's 1/N *chunk* instead of the full leaf.
+ZeRO sharding (``shard_axes``): under any stage of the optimizer-sharding
+ladder (parallel/zero.py, zero1/zero2/zero3) the transformation sees each
+leaf's 1/N *chunk* instead of the full leaf — the stages differ only in
+how grads/params are MOVED around the update, never in what the update
+math sees.
 Elementwise transforms (momentum, Adam moments, decoupled weight decay)
 are unaffected — same treedef, same per-element math, zero padding inert.
 Only NORMS see partial data, so the two norm consumers get sharded mirrors

@@ -274,7 +274,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        config: TrainConfig, input_kind: str = "image",
                        objective: str = "classify",
                        state_like: Optional[TrainState] = None,
-                       aot=None
+                       aot=None, zero_layout=None, params_struct=None
                        ) -> Callable[[TrainState, Any, jax.Array],
                                      tuple[TrainState, dict]]:
     """Build the jitted data-parallel train step.
@@ -286,15 +286,35 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     allreduce-average Horovod performs — so parameters stay bit-identical
     on every shard. BN running-stat updates are ``pmean``-ed likewise.
 
-    With ``config.optimizer_sharding == "zero1"`` the gradient sync stops at
-    the ring's halfway point: one ``psum_scatter`` per fusion bucket leaves
-    each shard holding the reduced 1/N chunk of every leaf, the optax update
-    runs on that chunk against permanently 1/N-sharded optimizer state
-    (parallel/zero.py), and the trailing ``all_gather`` moves the *updated
-    parameters* — same wire bytes as the ring all-reduce, optimizer
-    HBM/compute divided by the DP degree. ``state_like`` (the initialized
-    TrainState, chunked opt state included) is required then: it supplies
-    the per-leaf partition specs for shard_map.
+    ``config.optimizer_sharding`` climbs the ZeRO ladder (parallel/zero.py):
+
+    - ``zero1`` — the gradient sync stops at the ring's halfway point: one
+      ``psum_scatter`` per fusion bucket leaves each shard the reduced 1/N
+      chunk of every leaf, the optax update runs on that chunk against
+      permanently 1/N-sharded optimizer state, and the trailing
+      ``all_gather`` moves the *updated parameters* — same wire bytes as
+      the ring all-reduce, optimizer HBM/compute divided by N.
+    - ``zero2`` — same update math, but the loss is differentiated w.r.t.
+      the parameter CHUNKS through a per-bucket identity ``custom_vjp``
+      whose backward rule IS the bucket reduce-scatter: gradients are born
+      reduce-scattered during backward (overlapping remaining backward
+      compute) and the full gradient tree never materializes.
+    - ``zero3`` — parameters themselves live in the chunked global form
+      (``state.params`` leaves are padded flat ``(chunk*N,)`` arrays
+      sharded over the DP axes) and are all-gathered on demand per bucket
+      in forward, the gather's backward rule again the bucket
+      reduce-scatter. No parameter all-gather after the update — the
+      chunks ARE the persistent state.
+
+    ``config.overlap_collectives=False`` downgrades zero2/zero3 to the
+    serialized schedule (full grads after backward, one scatter pass) for
+    A/B measurement; update arithmetic is unchanged.
+
+    For any sharded stage ``state_like`` (the initialized TrainState) is
+    required — it supplies per-leaf partition specs for shard_map. Under
+    zero3 its params are chunked, so the FULL-shape ``params_struct`` and
+    the ``zero_layout`` built from it must be passed in (train/loop.py
+    does); other stages can derive both from ``state_like.params``.
 
     ``aot`` (a perf.aot.StepExecutableCache) switches the first call to the
     ahead-of-time path: load the serialized executable for this config
@@ -306,19 +326,32 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     accum = config.grad_accum_steps
 
     nan_steps, guard = _guard_config(config)
-    zero1 = getattr(config, "optimizer_sharding", "none") == "zero1"
+    stage = getattr(config, "optimizer_sharding", "none") or "none"
+    sharded = stage in ("zero1", "zero2", "zero3")
+    overlap = (stage in ("zero2", "zero3")
+               and getattr(config, "overlap_collectives", True))
     layout = payload = None
-    if zero1:
+    if sharded:
         if state_like is None:
             raise ValueError(
-                "optimizer_sharding='zero1' requires state_like= (the "
+                f"optimizer_sharding={stage!r} requires state_like= (the "
                 "initialized TrainState) so the step can derive the chunk "
                 "layout and per-leaf optimizer-state partition specs")
-        params_struct = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
-            state_like.params)
-        layout, payload = zero.layout_from_options(
-            params_struct, dp_size, options=config.allreduce)
+        if params_struct is None:
+            if stage == "zero3":
+                raise ValueError(
+                    "optimizer_sharding='zero3' requires params_struct= "
+                    "(full parameter shapes) — state_like.params is already "
+                    "chunked and cannot seed the layout")
+            params_struct = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+                state_like.params)
+        if zero_layout is not None:
+            layout = zero_layout
+            payload = zero.payload_dtype_from_options(config.allreduce)
+        else:
+            layout, payload = zero.layout_from_options(
+                params_struct, dp_size, options=config.allreduce)
 
     def step_fn(state: TrainState, batch, rng):
         TRACE_COUNTS["dp_train_step"] += 1  # trace-time only, not per call
@@ -328,13 +361,54 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         # Per-shard microbatching: the reshape is shard-local (free), and the
         # sum-over-examples gradient is grouping-invariant, so accum-N here
-        # equals the one-shot big-batch gradient.
-        grads, new_bn, metrics = accumulated_grads(
-            loss_fn, state.params, state.batch_stats, batch, rng, accum,
-            vary_axes=DATA_AXES)
+        # equals the one-shot big-batch gradient. (With the overlapped
+        # zero2/zero3 schedules each microbatch issues its own per-bucket
+        # scatters, so the cross-shard sum order differs from zero1's single
+        # post-accumulation scatter — same math, not bitwise; accum=1 is.)
+        gchunks = pchunks = None
+        if stage == "zero3":
+            # Inside shard_map the P(DATA_AXES) in_spec on the chunked
+            # global form means state.params leaves ARE this shard's local
+            # (chunk,) slices — no dynamic_slice needed.
+            pchunks = state.params
+            if overlap:
+                def chunk_loss(pc, bn, b, r):
+                    full = zero.gather_params_overlapped(
+                        pc, layout, DATA_AXES, payload_dtype=payload)
+                    return loss_fn(full, bn, b, r)
+                gchunks, new_bn, metrics = accumulated_grads(
+                    chunk_loss, pchunks, state.batch_stats, batch, rng,
+                    accum, vary_axes=DATA_AXES)
+            else:
+                full = zero.all_gather_chunks(pchunks, layout, DATA_AXES)
+                grads, new_bn, metrics = accumulated_grads(
+                    loss_fn, full, state.batch_stats, batch, rng, accum,
+                    vary_axes=DATA_AXES)
+        elif stage == "zero2" and overlap:
+            pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
+
+            def chunk_loss(pc, bn, b, r):
+                # state.params enters as a closure CONSTANT (the identity
+                # forward), so only the chunk cotangents survive — the full
+                # gradient tree is never a live value.
+                full = zero.assemble_params_overlapped(
+                    state.params, pc, layout, DATA_AXES,
+                    payload_dtype=payload)
+                return loss_fn(full, bn, b, r)
+
+            gchunks, new_bn, metrics = accumulated_grads(
+                chunk_loss, pchunks, state.batch_stats, batch, rng, accum,
+                vary_axes=DATA_AXES)
+        else:
+            grads, new_bn, metrics = accumulated_grads(
+                loss_fn, state.params, state.batch_stats, batch, rng, accum,
+                vary_axes=DATA_AXES)
 
         if nan_steps:
-            grads = _inject_nan_grads(grads, state.step, nan_steps)
+            if gchunks is not None:
+                gchunks = _inject_nan_grads(gchunks, state.step, nan_steps)
+            else:
+                grads = _inject_nan_grads(grads, state.step, nan_steps)
 
         metrics = jax.lax.pmean(metrics, DATA_AXES)
         if new_bn is not None:
@@ -342,22 +416,28 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # local per shard, matching per-GPU BN under Horovod).
             new_bn = jax.lax.pmean(new_bn, DATA_AXES)
 
-        if zero1:
-            # ZeRO-1: reduce-scatter (the ring's first half), shard-local
-            # optimizer update on this shard's 1/N chunk of every leaf, then
-            # all-gather the UPDATED parameters (the ring's second half,
-            # moved past the update). `tx` was built with shard_axes=
-            # DATA_AXES (train/optim.py), so any cross-leaf norms (global
-            # clip, LARS/LAMB trust ratios) psum their squared sums and the
+        if sharded:
+            # Shard-local optimizer update on this shard's 1/N chunk of
+            # every leaf. `tx` was built with shard_axes=DATA_AXES
+            # (train/optim.py), so any cross-leaf norms (global clip,
+            # LARS/LAMB trust ratios) psum their squared sums and the
             # chunked update matches the replicated one per element.
-            gchunks = zero.reduce_scatter(grads, layout, DATA_AXES,
-                                          payload_dtype=payload)
+            if gchunks is None:
+                # zero1 / overlap-off schedules: full gradient tree was
+                # materialized; run the ring's first half now.
+                gchunks = zero.reduce_scatter(grads, layout, DATA_AXES,
+                                              payload_dtype=payload)
             gchunks = jax.tree_util.tree_map(lambda g: g / dp_size, gchunks)
-            pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
+            if pchunks is None:
+                pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
             updates, new_opt = tx.update(gchunks, state.opt_state, pchunks)
             new_pchunks = optax.apply_updates(pchunks, updates)
-            new_params = zero.all_gather_chunks(new_pchunks, layout,
-                                                DATA_AXES)
+            if stage == "zero3":
+                # Chunks ARE the persistent parameter layout — no gather.
+                new_params = new_pchunks
+            else:
+                new_params = zero.all_gather_chunks(new_pchunks, layout,
+                                                    DATA_AXES)
         else:
             # The allreduce. compat.shard_map runs with replication checking
             # OFF, so autodiff does NOT auto-psum gradients for the
@@ -384,9 +464,14 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # (post-all-reduce here, post-all-gather under zero1).
             # Non-finite grads on ANY shard propagate through the reduction
             # and the optimizer into the params, so checking the result
-            # catches them — one local (collective-free) reduction per step.
+            # catches them — one local (collective-free) reduction per step,
+            # except under zero3 where new_params is this shard's chunks
+            # only and the norm needs a psum to stay shard-consistent.
+            sq = _tree_sq_norm(new_params)
+            if stage == "zero3":
+                sq = jax.lax.psum(sq, DATA_AXES)
             bad = jnp.logical_or(~jnp.isfinite(metrics["loss"]),
-                                 ~jnp.isfinite(_tree_sq_norm(new_params)))
+                                 ~jnp.isfinite(sq))
             # Skip-on-bad: the step index still advances (the batch is
             # consumed; a skip is a skip, not a retry), but params/opt/BN/
             # EMA keep their pre-update values so one poisoned batch can't
@@ -402,13 +487,22 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         return new_state, metrics
 
     batch_spec = P(DATA_AXES)
-    if zero1:
-        # Everything replicated EXCEPT the chunked optimizer-state leaves,
-        # which shard dim 0 over the DP axes (each shard sees its chunk).
-        opt_spec = zero.opt_state_specs(tx, state_like.params, layout,
+    if sharded:
+        # Everything replicated EXCEPT the chunked leaves, which shard dim 0
+        # over the DP axes (each shard sees its chunk): the opt state at
+        # every stage, plus params/ema at zero3.
+        opt_spec = zero.opt_state_specs(tx, params_struct, layout,
                                         P(DATA_AXES), P())
         state_spec = jax.tree_util.tree_map(lambda _: P(), state_like)
         state_spec = state_spec.replace(opt_state=opt_spec)
+        if stage == "zero3":
+            state_spec = state_spec.replace(
+                params=jax.tree_util.tree_map(lambda _: P(DATA_AXES),
+                                              state_like.params))
+            if state_like.ema_params is not None:
+                state_spec = state_spec.replace(
+                    ema_params=jax.tree_util.tree_map(
+                        lambda _: P(DATA_AXES), state_like.ema_params))
     else:
         state_spec = P()
     mapped = compat.shard_map(
@@ -434,6 +528,19 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     # (make_fused_train_loop): shard_map composes under an outer jit+scan.
     compiled.raw_step = mapped
     compiled.zero_layout = layout
+    compiled.zero_stage = stage if sharded else None
+    compiled.overlap = overlap
+    # Per-device gradient residency for the memory-ladder accounting
+    # (train/loop.py, bench.py): gradients are transient, so this is a
+    # model, not a measurement — see zero.modeled_grad_bytes.
+    if layout is not None:
+        compiled.grad_bytes_per_device = zero.modeled_grad_bytes(
+            layout, chunked=overlap)
+    elif state_like is not None:
+        compiled.grad_bytes_per_device = zero.modeled_grad_bytes(
+            zero.build_layout(state_like.params, 1), chunked=False)
+    else:
+        compiled.grad_bytes_per_device = None
     return compiled
 
 

@@ -94,11 +94,11 @@ def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     assert rc == 0
     models = [s[0] for s in seen]
     # SUITE's value-per-minute order: resnet50 + the two allreduce A/B
-    # rows + the zero1 row (all resnet50), bert flash, (gpt2 filtered
-    # out), bert dense, (resnet152 filtered), densenet121, (vit
-    # filtered), bert 2048.
-    assert models == ["resnet50", "resnet50", "resnet50", "resnet50",
-                      "bert_base", "bert_base", "densenet121", "bert_base"]
+    # rows + the three zero-ladder rows (all resnet50), bert flash,
+    # (gpt2 filtered out), bert dense, (resnet152 filtered),
+    # densenet121, (vit filtered), bert 2048.
+    assert models == ["resnet50"] * 6 + ["bert_base", "bert_base",
+                                         "densenet121", "bert_base"]
     # Suite rows must NOT inherit headline flags; row overrides apply.
     assert all(s[3] is False for s in seen[:3])  # remat reset
     out = [json.loads(line) for line in
@@ -314,9 +314,9 @@ def test_suite_order_contract_for_chip_window(bench):
     budget gating (value-per-minute prefix), so it is pinned too."""
     names = [n for n, _m, _o, _e in bench.SUITE]
     assert names == [
-        "resnet50", "ar_fused", "ar_perleaf", "zero1", "bert512_flash",
-        "gpt2_1024", "bert512", "resnet152", "densenet121", "vit_b16",
-        "bert2048_flash",
+        "resnet50", "ar_fused", "ar_perleaf", "zero1", "zero2", "zero3",
+        "bert512_flash", "gpt2_1024", "bert512", "resnet152",
+        "densenet121", "vit_b16", "bert2048_flash",
     ]
     key = {n: (m, o.get("attention_impl"), o.get("seq_len"),
                o.get("allreduce_bucket_mb"))
@@ -325,9 +325,13 @@ def test_suite_order_contract_for_chip_window(bench):
     assert key["ar_fused"] == ("resnet50", None, None, 4.0)
     assert key["ar_perleaf"] == ("resnet50", None, None, 0.0)
     assert key["zero1"] == ("resnet50", None, None, 4.0)
-    # zero1 pairs with ar_fused: identical protocol except the schedule
-    zrow = next(o for n, _m, o, _e in bench.SUITE if n == "zero1")
-    assert zrow["optimizer_sharding"] == "zero1"
+    # The zero-ladder rows pair with ar_fused: identical protocol except
+    # the sharding stage (chip_window.sh's zero_ladder step selects all
+    # four by name for the A/B).
+    for stage in ("zero1", "zero2", "zero3"):
+        assert key[stage] == ("resnet50", None, None, 4.0)
+        zrow = next(o for n, _m, o, _e in bench.SUITE if n == stage)
+        assert zrow["optimizer_sharding"] == stage
     assert key["bert512_flash"] == ("bert_base", "flash", 512, None)
     assert key["bert2048_flash"] == ("bert_base", "flash", 2048, None)
 

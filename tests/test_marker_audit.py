@@ -64,7 +64,8 @@ from tools.marker_audit import audit_perf_gate  # noqa: E402
 
 def test_audit_perf_gate_clean_run():
     records = [_rec("t::fast", 1.0),
-               {**_rec("t::gate", 5.0), "perf_gate": True}]
+               {**_rec("t::gate", 5.0), "perf_gate": True},
+               {**_rec("t::gate_zero2_overlap", 5.0), "perf_gate": True}]
     assert audit_perf_gate(records) == []
 
 
@@ -74,15 +75,24 @@ def test_audit_perf_gate_flags_missing_gate():
     assert problems[0].startswith("no perf_gate")
 
 
+def test_audit_perf_gate_flags_missing_zero2_workload():
+    """Both gate workloads must run: the headline proxy alone no longer
+    counts as full coverage once the sharded-schedule gate exists."""
+    problems = audit_perf_gate([{**_rec("t::gate", 5.0), "perf_gate": True}])
+    assert len(problems) == 1
+    assert "zero2_overlap" in problems[0]
+
+
 def test_audit_perf_gate_flags_slow_double_marking():
     """perf_gate + slow together silently removes the gate from tier-1
     (-m 'not slow') — the one static mistake that disarms it while every
     individual run still looks green."""
-    records = [{**_rec("t::gate", 5.0, slow=True), "perf_gate": True}]
+    records = [{**_rec("t::gate_zero2_overlap", 5.0, slow=True),
+                "perf_gate": True}]
     problems = audit_perf_gate(records)
     assert len(problems) == 1
     assert "BOTH perf_gate and slow" in problems[0]
-    assert "t::gate" in problems[0]
+    assert "t::gate_zero2_overlap" in problems[0]
 
 
 def test_cli_expect_perf_gate_flag(tmp_path):
@@ -96,10 +106,21 @@ def test_cli_expect_perf_gate_flag(tmp_path):
                           capture_output=True, text=True)
     assert proc.returncode == 1
     assert "no perf_gate-marked test ran" in proc.stdout
-    # With the gate present the opt-in run is clean.
+    # With only the headline gate present: quiet by default, but the
+    # opt-in run fails — the zero2_overlap workload is part of coverage.
+    headline_only = tmp_path / "headline_only.json"
+    headline_only.write_text(json.dumps(
+        [{**_rec("t::gate", 5.0), "perf_gate": True}]))
+    assert subprocess.run(cmd + [str(headline_only)]).returncode == 0
+    proc = subprocess.run(cmd + [str(headline_only), "--expect-perf-gate"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "zero2_overlap" in proc.stdout
+    # With both gate workloads present the opt-in run is clean.
     with_gate = tmp_path / "gate.json"
     with_gate.write_text(json.dumps(
-        [{**_rec("t::gate", 5.0), "perf_gate": True}]))
+        [{**_rec("t::gate", 5.0), "perf_gate": True},
+         {**_rec("t::gate_zero2_overlap", 5.0), "perf_gate": True}]))
     assert subprocess.run(
         cmd + [str(with_gate), "--expect-perf-gate"]).returncode == 0
     # slow+perf_gate double-marking fails even WITHOUT the opt-in.

@@ -160,3 +160,55 @@ def test_recalibrate_writes_usable_baseline(runner, tmp_path, monkeypatch):
     # A build gated against its own fresh recalibration passes.
     cur = runner.measure()
     assert perf_gate.compare(on_disk, cur) == [], (on_disk, cur)
+
+
+# --- the zero2_overlap extras workload --------------------------------------
+
+def test_load_baseline_extras_routing(tmp_path):
+    """Named workloads read their entry under "extras"; the default reads
+    the top level; an absent entry is None (-> "no baseline" violation)."""
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps(
+        {**_base(), "extras": {"zero2_overlap": _base(normalized_step=33.0)}}))
+    assert perf_gate.load_baseline(str(path))["normalized_step"] == 10.0
+    extra = perf_gate.load_baseline(str(path), name="zero2_overlap")
+    assert extra["normalized_step"] == 33.0
+    assert perf_gate.load_baseline(str(path), name="missing") is None
+
+
+def test_recalibrate_default_preserves_extras(runner, tmp_path):
+    """Recalibrating the headline workload must not drop the extras block
+    — otherwise every default recalibration silently disarms the
+    zero2_overlap gate."""
+    out = tmp_path / "b.json"
+    out.write_text(json.dumps(
+        {**_base(), "extras": {"zero2_overlap": _base(normalized_step=33.0)}}))
+    perf_gate.recalibrate(str(out), runner=runner, passes=1)
+    kept = perf_gate.load_baseline(str(out), name="zero2_overlap")
+    assert kept is not None and kept["normalized_step"] == 33.0
+    # And an extras recalibration into a missing file is refused loudly.
+    with pytest.raises(ValueError, match="default workload first"):
+        perf_gate.recalibrate(str(tmp_path / "absent.json"), runner=runner,
+                              passes=1, workload="zero2_overlap")
+
+
+@pytest.fixture(scope="module")
+def runner_zero2():
+    """ONE compiled zero2_overlap proxy (dp=2 CPU mesh, overlapped
+    ZeRO-2 schedule) shared by the sharded gate tests."""
+    return perf_gate.ProxyRunner(perf_gate.WORKLOADS["zero2_overlap"])
+
+
+@pytest.mark.perf_gate
+def test_perf_gate_live_zero2_overlap(runner_zero2, monkeypatch, tmp_path):
+    """The sharded-schedule gate: the overlapped ZeRO-2 proxy must sit
+    inside its extras baseline band, and a sharded-workload check must
+    never overwrite the headline doctor sidecar. Recalibrate with
+    `python tools/perf_gate.py --recalibrate --workload zero2_overlap`."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner_zero2, workload="zero2_overlap")
+    assert result["ok"], "\n".join(result["violations"])
+    assert result["workload_name"] == "zero2_overlap"
+    assert result["current"]["workload"]["optimizer_sharding"] == "zero2"
+    assert not (tmp_path / "last.json").exists()

@@ -166,19 +166,20 @@ timeout 480 python bench.py --suite --budget 440 \
   > "$RES/bench_allreduce_ab.json" 2>> "$RES/log.txt"
 note allreduce_ab
 
-check_stop zero_ab
-# 6c. ZeRO-1 vs fused all-reduce A/B (the sharded-optimizer verdict,
-# parallel/zero.py): same model/batch/bucket as the acceptance row; the
-# rows differ ONLY in the post-gradient schedule (all-reduce + replicated
-# update vs reduce-scatter + 1/N update + param all-gather). Throughput
-# delta + the per-device opt_state bytes both rows now record give the
-# memory-for-latency tradeoff on real hardware. zero1 emits under its own
-# _zero1 metric name, so the headline's last-good entry stays clean.
-# ~2 x 90 s + compile.
-timeout 480 python bench.py --suite --budget 440 \
-  --suite-rows ar_fused,zero1 \
-  > "$RES/bench_zero_ab.json" 2>> "$RES/log.txt"
-note zero_ab
+check_stop zero_ladder
+# 6c. ZeRO ladder A/B (parallel/zero.py): ar_fused (replicated baseline)
+# vs zero1 vs zero2 vs zero3, same model/batch/bucket throughout, so the
+# four rows differ ONLY in the gradient/update/param schedule. Each stage
+# emits under its own _<stage> metric name and every record carries the
+# per-device params/grads/opt-state resident bytes plus their sum and
+# peak HBM — the monotone memory ladder (replicated -> zero1 -> zero2 ->
+# zero3) and the overlap throughput cost land in one step. zero2/zero3
+# run the overlapped backward/collective schedule (the default).
+# ~4 x 90 s + compile.
+timeout 700 python bench.py --suite --budget 660 \
+  --suite-rows ar_fused,zero1,zero2,zero3 \
+  > "$RES/bench_zero_ladder.json" 2>> "$RES/log.txt"
+note zero_ladder
 
 check_stop real_data
 # 7. Remaining real-data legs: native C++ loader + grain only (tf was

@@ -227,6 +227,30 @@ def check_perf_gate() -> None:
         emit("perf_gate", ok=False, error=str(e)[:200])
 
 
+def check_sharding() -> None:
+    """Optimizer-sharding state of the LAST run (loop.py drops
+    .cache/last_run_sharding.json on process 0): active ZeRO stage,
+    whether the overlapped backward/collective schedule was in effect and
+    the measured overlap fraction, and opt-state offload — so "which
+    sharding did that run actually use?" is answerable from doctor output
+    without re-reading run logs. ok=True always: an absent sidecar just
+    means no sharded run has happened yet."""
+    path = os.path.join(REPO, ".cache", "last_run_sharding.json")
+    try:
+        with open(path) as fh:
+            side = json.load(fh)
+        if not isinstance(side, dict):
+            raise ValueError("sidecar is not a JSON object")
+        emit("optimizer_sharding", ok=True,
+             **{k: side.get(k) for k in (
+                 "optimizer_sharding", "overlap_collectives", "overlap",
+                 "overlap_fraction", "opt_state_offload", "dp", "model")})
+    except (OSError, ValueError) as e:
+        emit("optimizer_sharding", ok=True, last_run=None,
+             note=f"no sharding sidecar ({e.__class__.__name__}); "
+                  f"written by the first train run")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-timeout", type=int, default=45)
@@ -242,6 +266,7 @@ def main(argv=None) -> int:
     check_loader()
     check_caches(prune_days=args.prune)
     check_perf_gate()
+    check_sharding()
     return 0
 
 

@@ -64,6 +64,16 @@ def audit_perf_gate(records) -> list[str]:
             "no perf_gate-marked test ran — the CPU-proxy perf gate is "
             "not protecting this run (tests/test_perf_gate.py missing, "
             "renamed, or deselected?)")
+    elif not any("zero2" in (r.get("nodeid") or "") for r in gate):
+        # The gate is two workloads since the ZeRO ladder landed: the
+        # headline proxy AND the overlapped zero2 schedule (its extras
+        # baseline in perf_baselines.json). Losing the sharded one is the
+        # same silent-disarm failure mode as losing the gate entirely.
+        problems.append(
+            "perf_gate tests ran but none covers the zero2_overlap "
+            "workload — the sharded-schedule gate "
+            "(tests/test_perf_gate.py::test_perf_gate_live_zero2_overlap) "
+            "is missing, renamed, or deselected")
     for rec in gate:
         if rec.get("slow"):
             problems.append(
@@ -91,12 +101,14 @@ def main(argv=None) -> int:
         return 2
     violations = find_violations(records, threshold)
     # slow+perf_gate double-marking is checked on EVERY audit (it is a
-    # static mistake); the ran-at-all check is opt-in, because partial
-    # runs (pytest tests/test_flops.py) legitimately lack the gate.
+    # static mistake); the presence checks (gate ran at all, both gate
+    # workloads covered) are opt-in, because partial runs
+    # (pytest tests/test_flops.py) legitimately lack the gate.
     gate_problems = audit_perf_gate(records)
     if not expect_gate:
         gate_problems = [p for p in gate_problems
-                         if not p.startswith("no perf_gate")]
+                         if not p.startswith(("no perf_gate",
+                                              "perf_gate tests ran but"))]
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

@@ -11,6 +11,8 @@ diff in the PR that intentionally changed performance.
     python tools/perf_gate.py --json          # machine-readable result
     python tools/perf_gate.py --recalibrate   # rewrite perf_baselines.json
     python tools/perf_gate.py --inject-sleep 0.3   # prove the gate fires
+    python tools/perf_gate.py --workload zero2_overlap   # gate the sharded
+                                              # schedule (extras baseline)
 
 Always runs on CPU (JAX_PLATFORMS=cpu is forced before jax loads): the
 gate must never depend on — or touch — a chip tunnel.
@@ -25,6 +27,12 @@ import sys
 # must not turn the gate into a chip job (or a 75 s connect timeout).
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Multi-device CPU mesh, same forcing as tests/conftest.py: the sharded
+# gate workloads (e.g. zero2_overlap, dp=2) need more than one device.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -43,12 +51,19 @@ def main(argv=None) -> int:
                         "the gate must fail)")
     p.add_argument("--passes", type=int, default=3,
                    help="recalibration passes; fastest wins (default 3)")
+    p.add_argument("--workload", default="default",
+                   choices=sorted(perf_gate.WORKLOADS),
+                   help="named gate workload: 'default' is the headline "
+                        "proxy (top level of perf_baselines.json); others "
+                        "live under its 'extras' key (e.g. zero2_overlap "
+                        "gates the overlapped ZeRO-2 schedule)")
     p.add_argument("--json", action="store_true",
                    help="emit the full result as JSON on stdout")
     args = p.parse_args(argv)
 
     if args.recalibrate:
-        baseline = perf_gate.recalibrate(args.baseline, passes=args.passes)
+        baseline = perf_gate.recalibrate(args.baseline, passes=args.passes,
+                                         workload=args.workload)
         path = args.baseline or perf_gate.BASELINE_PATH
         if args.json:
             print(json.dumps(baseline, indent=2, sort_keys=True))
@@ -62,12 +77,14 @@ def main(argv=None) -> int:
         return 0
 
     result = perf_gate.check(args.baseline,
-                             inject_sleep_s=args.inject_sleep)
+                             inject_sleep_s=args.inject_sleep,
+                             workload=args.workload)
     if args.json:
         print(json.dumps(result, indent=2, sort_keys=True))
     else:
         cur = result["current"]
-        print(f"perf gate: {'PASS' if result['ok'] else 'FAIL'}")
+        print(f"perf gate [{args.workload}]: "
+              f"{'PASS' if result['ok'] else 'FAIL'}")
         print(f"  normalized_step {cur['normalized_step']} vs baseline "
               f"{result['baseline_normalized_step']} "
               f"(step {cur['step_time_ms']} ms / calib "

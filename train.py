@@ -99,14 +99,27 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "bandwidth-optimal psum_scatter+all_gather ring "
                         "form")
     p.add_argument("--optimizer-sharding", default=None,
-                   choices=["none", "zero1"],
-                   help="ZeRO-1 optimizer-state sharding for the explicit-"
-                        "DP path: reduce-scatter grads, update each "
-                        "shard's 1/N param chunk against permanently "
-                        "sharded optimizer state, all-gather updated "
-                        "params — same comm volume as the ring all-reduce, "
-                        "optimizer HBM divided by the DP degree "
-                        "(parallel/zero.py)")
+                   choices=["none", "zero1", "zero2", "zero3"],
+                   help="ZeRO sharding ladder for the explicit-DP path "
+                        "(parallel/zero.py): zero1 = 1/N-sharded optimizer "
+                        "state (reduce-scatter grads, chunk update, "
+                        "all-gather updated params); zero2 = + gradients "
+                        "born reduce-scattered during backward, full grad "
+                        "tree never materialized; zero3 = + parameters "
+                        "themselves 1/N-sharded, all-gathered on demand "
+                        "per fusion bucket (FSDP unified with the bucket "
+                        "planner)")
+    p.add_argument("--no-overlap-collectives", dest="overlap_collectives",
+                   action="store_false", default=None,
+                   help="zero2/zero3: disable backward/collective overlap "
+                        "(serialize every bucket's reduce-scatter after "
+                        "backward) — the A/B baseline schedule; update "
+                        "math is unchanged")
+    p.add_argument("--opt-state-offload", action="store_true", default=None,
+                   help="place the sharded optimizer-state chunks in host "
+                        "RAM (pinned_host memory kind) instead of HBM; "
+                        "requires runtime support (TPU), loud no-op "
+                        "fallback elsewhere")
     p.add_argument("--sync-bn", action="store_true", default=None,
                    help="cross-replica BatchNorm statistics (psum over the "
                         "data axis, torch SyncBatchNorm semantics; pure-DP "
@@ -346,6 +359,10 @@ def build_config(args: argparse.Namespace):
             allreduce=dataclasses.replace(cfg.allreduce, **ar_updates))
     if args.optimizer_sharding:
         cfg = cfg.replace(optimizer_sharding=args.optimizer_sharding)
+    if args.overlap_collectives is not None:
+        cfg = cfg.replace(overlap_collectives=args.overlap_collectives)
+    if args.opt_state_offload:
+        cfg = cfg.replace(opt_state_offload=True)
     if args.ema_decay is not None:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, ema_decay=args.ema_decay))

@@ -888,6 +888,106 @@ def _run_chaos(args) -> int:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _run_elastic_chaos(args) -> int:
+    """Elastic soak benchmark (CPU, no chip needed): a 2-host x 2-device
+    dp4 transformer job under ``launch.py --elastic`` loses host 1 to a
+    ``host_lost`` fault (SIGKILL + heartbeat suppressed), auto-re-forms at
+    dp2 from the last good checkpoint, then grows back to dp4 when the
+    survivor announces a ``host_rejoin`` — all with the global batch fixed,
+    so the trajectory matches an uninterrupted run to the last float32 ulp
+    (tests/test_elastic_resume.py proves that part; this benchmark measures
+    the OUTAGE). The record's value is ``reconfiguration_time_s`` — fault
+    detection to first post-resume step, both ends on the shared local
+    CLOCK_MONOTONIC — as stamped into the final attempt's run summary by
+    train/loop.py."""
+    import shutil
+    import tempfile
+
+    from distributeddeeplearning_tpu import hostmesh
+    from distributeddeeplearning_tpu.observability import perf_report
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    metric = "reconfiguration_time_s"
+    steps, lose_at, rejoin_at = 12, 4, 8
+    root = tempfile.mkdtemp(prefix="ddl_elastic_")
+    cache = os.path.join(root, "cache")
+    os.makedirs(cache, exist_ok=True)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update(hostmesh.virtual_host_env(2))  # 2 fake devices per host
+    env.update(DDL_COMPILE_CACHE=cache, JAX_COMPILATION_CACHE_DIR=cache)
+
+    def fail(stage: str, proc=None, detail: str = "") -> int:
+        tail = detail or (getattr(proc, "stderr", "") or "")[-600:]
+        rc = getattr(proc, "returncode", None)
+        print(json.dumps(perf_report.annotate({
+            "metric": metric, "value": None, "unit": "s",
+            "error": f"{stage} failed rc={rc}: {tail}"},
+            provenance="error")), flush=True)
+        return 0
+
+    cmd = [sys.executable, os.path.join(base, "launch.py"),
+           "--num-processes", "2", "--elastic",
+           "--max-restarts", "2", "--backoff", "0.2",
+           "--heartbeat-dir", os.path.join(root, "hb"),
+           # Attempt 0: host 1 dies at dp4 -> shrink to dp2. Attempt 1:
+           # the survivor (original host 0) announces a rejoin -> graceful
+           # stop, grow back to dp4. Attempt 2 runs fault-free to the end.
+           "--child-fault-plan", f"1:host_lost@{lose_at}",
+           "--child-fault-plan", f"0:host_rejoin@{rejoin_at}:a1",
+           "--",
+           sys.executable, os.path.join(base, "train.py"),
+           "--backend", "cpu", "--synthetic", "--model", "bert_tiny",
+           "--seq-len", "32", "--batch-size", "8", "--dtype", "float32",
+           "--dp", "4", "--steps", str(steps),
+           "--checkpoint-every", "2", "--log-every", "1000",
+           "--checkpoint-dir", os.path.join(root, "ckpt")]
+    try:
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True, timeout=900)
+        except subprocess.TimeoutExpired as e:
+            return fail("soak", detail=f"timeout after {e.timeout}s")
+        wall = time.monotonic() - t0
+        if proc.returncode != 0:
+            return fail("soak", proc)
+        if "elastic re-formation (host_lost)" not in proc.stderr:
+            return fail("soak", proc, detail="no host_lost re-formation in "
+                        "launcher output")
+        summary = _last_summary(proc.stdout)
+        if not summary or summary.get(metric) is None:
+            return fail("soak", proc,
+                        detail="final summary carries no "
+                        f"{metric} (elastic event not delivered?)")
+        event = summary.get("elastic_event") or {}
+        grew = "elastic re-formation (host_rejoin)" in proc.stderr
+        rec = {
+            "metric": metric,
+            "value": round(float(summary[metric]), 2),
+            "unit": "s per re-formation",
+            "vs_baseline": None,
+            "trigger": event.get("trigger"),
+            "degree_before": event.get("degree_before"),
+            "degree_after": event.get("degree_after"),
+            "reformations": proc.stderr.count("# launcher: elastic event:"),
+            "grew_back": grew,
+            "final_step": summary.get("final_step"),
+            "total_s": round(wall, 1),
+            "protocol": (f"cpu bert_tiny b8 seq32 {steps} steps, 2 hosts x "
+                         f"2 devices, host_lost@{lose_at} shrinks dp4->dp2, "
+                         f"host_rejoin@{rejoin_at} grows dp2->dp4, global "
+                         f"batch fixed; value = launcher fault detection -> "
+                         f"first post-resume step of the last re-formation "
+                         f"(shared CLOCK_MONOTONIC)"),
+        }
+        perf_report.annotate(rec, provenance="fresh")
+        print(json.dumps(rec), flush=True)
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _parse_record(line: str):
     """A parseable bench record (measurement or per-config error), or None."""
     if not line.startswith("{"):
@@ -1119,6 +1219,13 @@ def main(argv=None) -> int:
                         "cache disabled and report the cold-cache recovery "
                         "overhead next to the warm one (roughly doubles the "
                         "chaos runtime)")
+    p.add_argument("--chaos-elastic", action="store_true",
+                   help="CPU elastic soak benchmark: a 2-host dp4 "
+                        "transformer job loses a host (host_lost fault), "
+                        "auto-re-forms at dp2, grows back to dp4 on rejoin, "
+                        "and reports the measured reconfiguration_time_s "
+                        "(fault detection -> first post-resume step) as one "
+                        "provenance-stamped record (no chip needed)")
     p.add_argument("--compile-cache-dir", default=None,
                    help="persistent compile cache + AOT step executables "
                         "shared by parent/child/suite rows "
@@ -1129,6 +1236,8 @@ def main(argv=None) -> int:
 
     if args.chaos:
         return _run_chaos(args)
+    if args.chaos_elastic:
+        return _run_elastic_chaos(args)
 
     if args.fused_conv3 and not args.fused_block:
         # Same up-front reject as train.py: on a scarce chip window this

@@ -14,6 +14,23 @@ import os
 import re
 
 
+def virtual_host_env(devices_per_host: int) -> dict[str, str]:
+    """Env vars that give a CHILD process a virtual CPU host with
+    ``devices_per_host`` devices — the per-host half of a simulated pod
+    (launch.py --num-processes N gives the other half). Used by the elastic
+    soak (bench.py, tests/test_elastic_resume.py): N hosts x M fake devices
+    re-form to a smaller N at the same M after a host loss.
+
+    Unlike :func:`pin_virtual_cpu_mesh` this only RETURNS the env (for
+    subprocess spawning); the child's own jax init applies it.
+    """
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={int(devices_per_host)}",
+    }
+
+
 def pin_virtual_cpu_mesh(n_devices: int = 8) -> None:
     """Force an ``n_devices`` virtual-CPU platform before any backend init."""
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)

@@ -32,6 +32,7 @@ Usage (TPU pod slice, run on every host, e.g. via gcloud ssh --worker=all):
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 import subprocess
@@ -105,6 +106,22 @@ def maybe_initialize_distributed() -> Optional[int]:
             process_id=int(os.environ[ENV_PROCESS_ID]),
             num_processes=int(os.environ[ENV_NUM_PROCESSES]),
             coordinator=os.environ[ENV_COORDINATOR])
+        if spec.num_processes > 1:
+            # Multi-process on the CPU backend (virtual hosts: tests, the
+            # elastic soak, chaos bench) needs a real cross-process
+            # collectives transport. jaxlib's CPU client defaults to
+            # 'none' and then rejects ANY computation spanning processes
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend"); the option is config-only — jax never reads it
+            # from the environment — so exporting a var in the launcher
+            # cannot fix it. Gloo-over-TCP ships in jaxlib; turn it on
+            # before the first backend use. No-op on TPU (the option only
+            # affects CPU clients) and on jax builds without it.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError):
+                pass
         jax.distributed.initialize(
             coordinator_address=spec.coordinator,
             num_processes=spec.num_processes,
@@ -132,12 +149,173 @@ def spawn(spec: ProcessSpec, command: Sequence[str], *,
     return subprocess.Popen(list(command), env=env)
 
 
+def attribute_failure(heartbeat_dir: Optional[str], slot: int, *,
+                      hung: bool = False, ever_beat: bool = False) -> str:
+    """Classify one failed child from the heartbeat evidence.
+
+    The hang watchdog and the elastic controller share ONE staleness clock
+    (``--heartbeat-timeout`` over the same files), so the three verdicts
+    partition cleanly:
+
+    - ``hung``       — the watchdog killed it for heartbeat staleness while
+      the process lived; the host is unusable either way, so elastic mode
+      treats it as host loss.
+    - ``host_lost``  — the child HAD a heartbeat and the file vanished with
+      the process: a dead host takes its filesystem presence with it (the
+      ``host_lost`` fault models exactly this). A transient crash leaves
+      its last heartbeat behind.
+    - ``crash``      — heartbeat intact (or never armed): the host is fine,
+      the process died; the generic restart path applies.
+    """
+    if hung:
+        return "hung"
+    if (heartbeat_dir is not None and ever_beat and not os.path.exists(
+            health.heartbeat_path(heartbeat_dir, slot))):
+        return "host_lost"
+    return "crash"
+
+
+class ElasticController:
+    """Membership controller for ``--elastic``: automatic re-formation at a
+    new data-parallel degree on host loss or gain.
+
+    The controller owns the live host set of a local simulated pod. When
+    the monitor attributes a failure as host loss (or hang — same staleness
+    clock), the lost host leaves the set and the next attempt re-plans at
+    the surviving degree: fewer processes, the training command's ``--dp``
+    rewritten to ``devices_per_host x live_hosts``, coordinator env
+    re-exported by ``plan_local`` as usual. The global batch is left
+    untouched, so a transformer trajectory continues bitwise through the
+    re-formation (tests/test_elastic_resume.py). A returning host announces
+    itself through the rejoin marker (observability/health.py); the monitor
+    then stops the job gracefully (children save at the next step boundary
+    via the loop's preemption handler) and the same machinery grows the
+    plan back.
+
+    Re-formations are PLANNED reconfigurations: ``run_with_restarts``
+    relaunches without exponential backoff (the delay exists to
+    de-synchronise shared-cause crash storms) and without burning the
+    restart budget (which guards against crash loops — a re-formation IS
+    the recovery). Pure stdlib, like the rest of the launcher.
+    """
+
+    def __init__(self, num_hosts: int, heartbeat_dir: str, *, base_dp: int,
+                 min_hosts: int = 1,
+                 tele: Optional[telemetry.Telemetry] = None):
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        if base_dp % num_hosts:
+            raise ValueError(
+                f"--dp {base_dp} does not divide evenly over {num_hosts} "
+                f"host(s); elastic re-formation needs a whole number of "
+                f"data shards per host")
+        self.max_hosts = num_hosts
+        self.devices_per_host = base_dp // num_hosts
+        self.heartbeat_dir = heartbeat_dir
+        self.min_hosts = max(int(min_hosts), 1)
+        self.tele = tele
+        self.live = list(range(num_hosts))   # original host ids, sorted
+        self.events: list[dict] = []         # committed re-formations
+        self._slots = list(self.live)        # slot -> host id, per attempt
+        self._pending: Optional[dict] = None
+        self._export: Optional[dict] = None
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.live)
+
+    @property
+    def degree(self) -> int:
+        return self.devices_per_host * len(self.live)
+
+    def command(self, command: Sequence[str]) -> list[str]:
+        """The training command at the current degree (``--dp`` rewritten;
+        global batch untouched — trajectories stay bitwise)."""
+        return _with_flag_value(command, "--dp", str(self.degree))
+
+    def child_env(self, base: dict[int, dict[str, str]]) -> dict:
+        """Per-slot extra env for the next attempt. Fault plans follow the
+        ORIGINAL host identity across re-formations (a plan injected into
+        host 2 stays with host 2 whatever slot it lands on), and every
+        child of a re-formed attempt receives the membership event
+        (``DDL_ELASTIC_EVENT``) so the loop can close the
+        reconfiguration_time_s span on the shared monotonic clock."""
+        self._slots = list(self.live)
+        out: dict[int, dict[str, str]] = {}
+        for slot, host in enumerate(self._slots):
+            env = dict(base.get(host) or {})
+            if self._export is not None:
+                env[health.ENV_ELASTIC_EVENT] = json.dumps(self._export)
+            out[slot] = env
+        self._export = None  # the event tags exactly one attempt
+        return out
+
+    def note_failure(self, slot: int, rc: int, *, hung: bool = False,
+                     ever_beat: bool = False) -> str:
+        """Attribute one failed child; on host loss, shrink the membership
+        and plan a re-formation. Returns the attribution string."""
+        label = attribute_failure(self.heartbeat_dir, slot, hung=hung,
+                                  ever_beat=ever_beat)
+        if label in ("hung", "host_lost"):
+            host = (self._slots[slot] if slot < len(self._slots) else None)
+            if host is not None and host in self.live:
+                before = self.degree
+                self.live.remove(host)
+                self._plan(label, before)
+        return label
+
+    def poll_rejoin(self) -> bool:
+        """Consume a rejoin announcement. True when lost hosts returned and
+        a grow re-formation is now planned — the monitor should then stop
+        the job gracefully. A marker with no one missing is consumed and
+        ignored (the cluster is already whole)."""
+        if not health.consume_rejoin(self.heartbeat_dir):
+            return False
+        if len(self.live) >= self.max_hosts:
+            return False
+        before = self.degree
+        self.live = list(range(self.max_hosts))
+        self._plan("host_rejoin", before)
+        return True
+
+    def _plan(self, trigger: str, degree_before: int) -> None:
+        now = telemetry.now_s()
+        if self._pending is None:
+            self._pending = {"trigger": trigger,
+                             "degree_before": degree_before,
+                             "degree_after": self.degree,
+                             "detect_t": now}
+        else:
+            # Several hosts lost in one poll: one re-formation, spanning
+            # from the pre-batch degree to the final survivors.
+            self._pending["degree_after"] = self.degree
+
+    def take_reconfiguration(self) -> Optional[dict]:
+        """The planned membership change for the next attempt, or None.
+        Consumes the plan and arms the event export for the re-formed
+        children. Returns None (give up -> generic failure path) when the
+        surviving set is below ``min_hosts``."""
+        event, self._pending = self._pending, None
+        if event is None:
+            return None
+        if len(self.live) < self.min_hosts or not self.live:
+            print(f"# launcher: elastic: only {len(self.live)} host(s) "
+                  f"survive (min {self.min_hosts}) — cannot re-form, "
+                  f"giving up", file=sys.stderr, flush=True)
+            return None
+        event["degree_after"] = self.degree
+        self.events.append(dict(event))
+        self._export = dict(event)
+        return event
+
+
 def monitor(children: Sequence[subprocess.Popen], *,
             poll_interval_s: float = 0.2,
             grace_s: float = 10.0,
             heartbeat_dir: Optional[str] = None,
             heartbeat_timeout_s: float = 0.0,
-            tele: Optional[telemetry.Telemetry] = None) -> int:
+            tele: Optional[telemetry.Telemetry] = None,
+            elastic: Optional["ElasticController"] = None) -> int:
     """Wait for all children; kill the survivors as soon as one fails.
 
     Returns 0 iff every child exited 0 — the contract a restart wrapper
@@ -149,11 +327,26 @@ def monitor(children: Sequence[subprocess.Popen], *,
     loader) and SIGKILLed — the next poll then attributes it and tears the
     job down fail-whole, exactly like a crash. A child that never beat is
     never judged, so startup/compile time needs no grace tuning.
+
+    With an ``elastic`` controller, failures are attributed from the
+    heartbeat evidence (crash vs host_lost vs hung) and host losses shrink
+    the controller's membership for the next attempt; a rejoin marker in
+    the heartbeat dir stops the job gracefully (SIGTERM → children save at
+    the next step boundary) so the next attempt can grow back.
     """
     procs = list(children)
     hb_armed = heartbeat_dir is not None and heartbeat_timeout_s > 0
+    track_beats = heartbeat_dir is not None and (hb_armed or
+                                                 elastic is not None)
+    ever_beat: set[int] = set()   # slots whose heartbeat file ever appeared
+    hung: set[int] = set()        # slots the watchdog killed for staleness
     try:
         while True:
+            if track_beats:
+                for idx in range(len(procs)):
+                    if idx not in ever_beat and os.path.exists(
+                            health.heartbeat_path(heartbeat_dir, idx)):
+                        ever_beat.add(idx)
             if hb_armed:
                 for idx, age in health.check_stale(
                         heartbeat_dir, len(procs), heartbeat_timeout_s):
@@ -165,7 +358,21 @@ def monitor(children: Sequence[subprocess.Popen], *,
                         if tele is not None:
                             tele.instant("launcher:heartbeat_stale",
                                          child=idx, age_s=round(age, 1))
+                        hung.add(idx)
                         procs[idx].kill()
+            if elastic is not None and elastic.poll_rejoin():
+                # A lost host came back: stop the job GRACEFULLY (SIGTERM,
+                # generous grace so every child saves at its next step
+                # boundary via the loop's preemption handler) and report
+                # nonzero — run_with_restarts then relaunches at the grown
+                # degree without burning the budget.
+                print("# launcher: host rejoin announced — stopping to "
+                      "re-form at the grown degree",
+                      file=sys.stderr, flush=True)
+                if tele is not None:
+                    tele.instant("launcher:host_rejoin")
+                _terminate_all(procs, max(grace_s, 30.0))
+                return 1
             codes = [p.poll() for p in procs]
             failed = [(i, c) for i, c in enumerate(codes)
                       if c not in (None, 0)]
@@ -175,8 +382,22 @@ def monitor(children: Sequence[subprocess.Popen], *,
                 # operator can no longer tell the culprit from the victims.
                 for idx, c in failed:
                     why = f" (killed by signal {-c})" if c < 0 else ""
-                    print(f"# launcher: child {idx} exited rc={c}{why}",
-                          file=sys.stderr, flush=True)
+                    attributed = ""
+                    if heartbeat_dir is not None:
+                        if elastic is not None:
+                            label = elastic.note_failure(
+                                idx, int(c), hung=idx in hung,
+                                ever_beat=idx in ever_beat)
+                        else:
+                            label = attribute_failure(
+                                heartbeat_dir, idx, hung=idx in hung,
+                                ever_beat=idx in ever_beat)
+                        attributed = f" [attributed: {label}]"
+                        if tele is not None:
+                            tele.instant("launcher:failure_attributed",
+                                         child=idx, attribution=label)
+                    print(f"# launcher: child {idx} exited rc={c}{why}"
+                          f"{attributed}", file=sys.stderr, flush=True)
                 survivors = sum(1 for c in codes if c is None)
                 if survivors:
                     print(f"# launcher: terminating {survivors} surviving "
@@ -210,7 +431,8 @@ def run_local(num_processes: int, command: Sequence[str], *,
               child_env: Optional[dict[int, dict[str, str]]] = None,
               heartbeat_dir: Optional[str] = None,
               heartbeat_timeout_s: float = 0.0,
-              tele: Optional[telemetry.Telemetry] = None) -> int:
+              tele: Optional[telemetry.Telemetry] = None,
+              elastic: Optional["ElasticController"] = None) -> int:
     """Spawn + monitor N local processes (the `mpirun -np N` replacement).
 
     ``child_env`` maps process_id → extra env vars for that child only —
@@ -235,7 +457,8 @@ def run_local(num_processes: int, command: Sequence[str], *,
             extra[health.ENV_HEARTBEAT_DIR] = heartbeat_dir
         children.append(spawn(s, command, extra_env=extra))
     return monitor(children, heartbeat_dir=heartbeat_dir,
-                   heartbeat_timeout_s=heartbeat_timeout_s, tele=tele)
+                   heartbeat_timeout_s=heartbeat_timeout_s, tele=tele,
+                   elastic=elastic)
 
 
 def _backoff_delay(attempt: int, base_s: float, cap_s: float) -> float:
@@ -265,7 +488,8 @@ def run_with_restarts(run_once, max_restarts: int, *,
                       backoff_cap_s: float = 60.0,
                       progress_fn: Optional[Callable[[], object]] = None,
                       sleep=None,
-                      tele: Optional[telemetry.Telemetry] = None) -> int:
+                      tele: Optional[telemetry.Telemetry] = None,
+                      elastic: Optional["ElasticController"] = None) -> int:
     """Fail-whole + auto-relaunch: the in-launcher restart wrapper.
 
     The reference's failure story was "mpirun dies whole, Batch AI resubmits
@@ -291,6 +515,14 @@ def run_with_restarts(run_once, max_restarts: int, *,
     (``DDL_RESTART_ATTEMPT``) so attempt-scoped fault injection
     (robustness/faults.py) fires only on the intended attempt.
 
+    With an ``elastic`` controller, an attempt that ended in a PLANNED
+    membership change (host lost -> shrink; host rejoined -> grow)
+    relaunches immediately: no exponential backoff (the delay exists to
+    de-synchronise shared-cause crash storms, not planned
+    reconfigurations) and no restart-budget charge (the budget guards
+    against crash loops; a re-formation IS the recovery). ^C (rc 130)
+    still stops unconditionally.
+
     ``sleep`` is injectable for tests (defaults to ``time.sleep``).
     """
     do_sleep = sleep if sleep is not None else time.sleep
@@ -308,6 +540,32 @@ def run_with_restarts(run_once, max_restarts: int, *,
             if tele is not None:
                 tele.instant("launcher:attempt_failed", rc=rc,
                              attempt=total - 1)
+            if rc == 130:
+                # ^C is ALWAYS an operator stop, even mid-reconfiguration.
+                print(f"# launcher: operator stop (rc={rc}); not retrying",
+                      file=sys.stderr, flush=True)
+                return rc
+            if elastic is not None:
+                event = elastic.take_reconfiguration()
+                if event is not None:
+                    print(f"# launcher: elastic re-formation "
+                          f"({event['trigger']}): degree "
+                          f"{event['degree_before']} -> "
+                          f"{event['degree_after']} — relaunching "
+                          f"immediately (planned reconfiguration: no "
+                          f"backoff, budget untouched)",
+                          file=sys.stderr, flush=True)
+                    if tele is not None:
+                        tele.instant("launcher:elastic_reconfigure",
+                                     trigger=event["trigger"],
+                                     degree_before=event["degree_before"],
+                                     degree_after=event["degree_after"])
+                    if progress_fn is not None:
+                        # A re-formed attempt starts a fresh progress
+                        # window — don't let the pre-shrink baseline
+                        # double-count as progress later.
+                        last_progress = progress_fn()
+                    continue
             if rc in _OPERATOR_STOP_RCS:
                 print(f"# launcher: operator stop (rc={rc}); not retrying",
                       file=sys.stderr, flush=True)
@@ -397,6 +655,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--heartbeat-dir", default=None,
                    help="heartbeat file directory (default: a fresh temp "
                         "dir; local --num-processes jobs only)")
+    p.add_argument("--elastic", action="store_true",
+                   help="automatic mesh re-formation on host loss/gain: a "
+                        "child attributed as a lost host (its heartbeat "
+                        "vanished with it, or the hang watchdog killed it) "
+                        "shrinks the plan and the job relaunches at the "
+                        "surviving --dp degree from the latest checkpoint, "
+                        "without sleeping the backoff or burning the "
+                        "restart budget; a rejoin marker in the heartbeat "
+                        "dir grows it back. Requires a local "
+                        "--num-processes job whose command names --dp and "
+                        "--checkpoint-dir; the global batch is unchanged, "
+                        "so trajectories stay bitwise "
+                        "(docs/fault_tolerance.md)")
+    p.add_argument("--min-hosts", type=int, default=1,
+                   help="with --elastic, give up (generic failure path) "
+                        "instead of re-forming below this many hosts")
     p.add_argument("--compile-cache-dir", default=None,
                    help="persistent compile cache shared by every child and "
                         "every restart attempt (docs/compile_cache.md); "
@@ -450,6 +724,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             p.error("--max-restarts only supports local (--num-processes) "
                     "jobs; for --hostfile, wrap the launcher in a "
                     "whole-job resubmit loop on every host")
+        if args.elastic:
+            # Elastic re-formation re-plans the LOCAL process set; a
+            # hostfile job's membership lives across machines where this
+            # launcher only owns one child.
+            p.error("--elastic only supports local (--num-processes) jobs")
         return run_from_hostfile(args.hostfile, args.process_id, command,
                                  port=args.port)
     n = args.num_processes or 1
@@ -468,10 +747,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         progress_fn = lambda: _latest_ckpt_step(ckpt_dir)  # noqa: E731
 
     heartbeat_dir = None
-    if args.heartbeat_timeout > 0:
+    if args.heartbeat_timeout > 0 or args.elastic:
         import tempfile
         heartbeat_dir = args.heartbeat_dir or tempfile.mkdtemp(
             prefix="ddl_heartbeat_")
+        os.makedirs(heartbeat_dir, exist_ok=True)
 
     # When the training command traces (--trace-dir), the launcher records
     # its restart/backoff/stale-heartbeat instants too and merges them into
@@ -484,13 +764,60 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         tele = telemetry.Telemetry(enabled=True, process_index=os.getpid(),
                                    process_name="launcher")
 
+    elastic_ctl = None
+    if args.elastic:
+        dp_s = _flag_from_command(command, "--dp")
+        if dp_s is None or not dp_s.isdigit():
+            p.error("--elastic requires the training command to name an "
+                    "explicit integer --dp (the degree the controller "
+                    "re-plans)")
+        if ckpt_dir is None:
+            p.error("--elastic requires the training command to name "
+                    "--checkpoint-dir (re-formation resumes from the "
+                    "latest checkpoint)")
+        fsdp_s = _flag_from_command(command, "--fsdp")
+        if fsdp_s not in (None, "1"):
+            # Shrinking fsdp re-shards parameters mid-plan; the converter
+            # handles the CHECKPOINT side bitwise, but the per-host device
+            # arithmetic here only re-plans the data axis.
+            p.error("--elastic re-plans the --dp axis only; run with "
+                    "--fsdp 1 (or drop --fsdp)")
+        base_dp = int(dp_s)
+        if base_dp % n:
+            p.error(f"--elastic: --dp {base_dp} must divide evenly over "
+                    f"--num-processes {n}")
+        # A stale rejoin marker from a previous job must not trigger a
+        # phantom grow on the first failure of this one.
+        health.consume_rejoin(heartbeat_dir)
+        elastic_ctl = ElasticController(n, heartbeat_dir, base_dp=base_dp,
+                                        min_hosts=args.min_hosts, tele=tele)
+
+    if elastic_ctl is not None:
+        run_once = lambda: run_local(  # noqa: E731
+            elastic_ctl.num_processes, elastic_ctl.command(command),
+            port=args.port, child_env=elastic_ctl.child_env(child_env),
+            heartbeat_dir=heartbeat_dir,
+            heartbeat_timeout_s=args.heartbeat_timeout,
+            tele=tele, elastic=elastic_ctl)
+    else:
+        run_once = lambda: run_local(  # noqa: E731
+            n, command, port=args.port, child_env=child_env,
+            heartbeat_dir=heartbeat_dir,
+            heartbeat_timeout_s=args.heartbeat_timeout, tele=tele)
+
     rc = run_with_restarts(
-        lambda: run_local(n, command, port=args.port, child_env=child_env,
-                          heartbeat_dir=heartbeat_dir,
-                          heartbeat_timeout_s=args.heartbeat_timeout,
-                          tele=tele),
-        args.max_restarts, backoff_s=args.backoff,
-        backoff_cap_s=args.backoff_cap, progress_fn=progress_fn, tele=tele)
+        run_once, args.max_restarts, backoff_s=args.backoff,
+        backoff_cap_s=args.backoff_cap, progress_fn=progress_fn, tele=tele,
+        elastic=elastic_ctl)
+    if elastic_ctl is not None and elastic_ctl.events:
+        for ev in elastic_ctl.events:
+            print(f"# launcher: elastic event: {ev['trigger']} degree "
+                  f"{ev['degree_before']} -> {ev['degree_after']}",
+                  file=sys.stderr, flush=True)
+        print(f"# launcher: elastic: {len(elastic_ctl.events)} "
+              f"re-formation(s), final degree {elastic_ctl.degree} "
+              f"({elastic_ctl.num_processes}/{elastic_ctl.max_hosts} hosts)",
+              file=sys.stderr, flush=True)
     if tele is not None:
         tele.export(telemetry.trace_path(trace_dir, 0))
     return rc
@@ -504,6 +831,23 @@ def _flag_from_command(command: Sequence[str], flag: str) -> Optional[str]:
         if tok.startswith(flag + "="):
             return tok.split("=", 1)[1]
     return None
+
+
+def _with_flag_value(command: Sequence[str], flag: str,
+                     value: str) -> list[str]:
+    """The command with ``flag`` set to ``value`` (rewritten in place for
+    both ``--flag V`` and ``--flag=V`` spellings; appended if absent) —
+    how the elastic controller re-plans ``--dp`` at the surviving degree."""
+    out = list(command)
+    for i, tok in enumerate(out):
+        if tok == flag and i + 1 < len(out):
+            out[i + 1] = value
+            return out
+        if tok.startswith(flag + "="):
+            out[i] = f"{flag}={value}"
+            return out
+    out.extend([flag, value])
+    return out
 
 
 def _checkpoint_dir_from_command(command: Sequence[str]) -> Optional[str]:

@@ -27,9 +27,60 @@ from typing import Optional
 ENV_HEARTBEAT_DIR = "DDL_HEARTBEAT_DIR"
 _ENV_PROCESS_ID = "DDL_PROCESS_ID"  # set by launch.ProcessSpec.env()
 
+# Elastic membership (launch.py --elastic). The launcher exports one JSON
+# env var to the children of a re-formed attempt — {"trigger": "host_lost" |
+# "hung" | "host_rejoin", "degree_before": D0, "degree_after": D1,
+# "detect_t": monotonic-seconds-at-detection} — so the training loop can
+# close the reconfiguration_time_s span (detection -> first post-resume
+# step) on the SAME CLOCK_MONOTONIC clock the launcher read. The rejoin
+# marker file is how a returning host announces itself to the membership
+# controller: its launcher (or the host_rejoin fault, in simulation)
+# touches it in the shared heartbeat directory.
+ENV_ELASTIC_EVENT = "DDL_ELASTIC_EVENT"
+_REJOIN_MARKER = "rejoin"
+
 
 def heartbeat_path(directory: str, process_id: int) -> str:
     return os.path.join(directory, f"heartbeat.{process_id}")
+
+
+def rejoin_path(directory: str) -> str:
+    return os.path.join(directory, _REJOIN_MARKER)
+
+
+def announce_rejoin(directory: str) -> None:
+    """Touch the rejoin marker — a returned host asking the elastic
+    controller to grow the job back. Atomic (tmp + replace), best-effort."""
+    tmp = os.path.join(directory, f".{_REJOIN_MARKER}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w") as fh:
+            json.dump({"time": time.time(), "pid": os.getpid()}, fh)
+        os.replace(tmp, rejoin_path(directory))
+    except OSError:
+        pass
+
+
+def consume_rejoin(directory: str) -> bool:
+    """True iff a rejoin marker existed; the marker is removed (consumed)
+    so one announcement triggers exactly one re-formation."""
+    try:
+        os.remove(rejoin_path(directory))
+        return True
+    except OSError:
+        return False
+
+
+def read_elastic_event() -> Optional[dict]:
+    """The launcher-exported membership event this process was re-formed
+    under, or None on a normal (non-reconfigured) attempt."""
+    raw = os.environ.get(ENV_ELASTIC_EVENT)
+    if not raw:
+        return None
+    try:
+        event = json.loads(raw)
+    except ValueError:
+        return None
+    return event if isinstance(event, dict) else None
 
 
 class HeartbeatWriter:

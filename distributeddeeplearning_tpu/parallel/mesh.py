@@ -121,6 +121,17 @@ def data_axis_names(parallel: ParallelConfig) -> tuple[str, ...]:
     return ("data", "fsdp")
 
 
+def data_parallel_degree(parallel: ParallelConfig) -> int:
+    """Number of data shards (product of the data-parallel family axes).
+
+    This is the degree the elastic launcher re-plans on host loss/gain
+    (launch.py --elastic): gradients are allreduce-MEANS over the data axes
+    at a fixed global batch, so the degree can change between attempts while
+    the optimizer trajectory stays bitwise (docs/fault_tolerance.md).
+    """
+    return int(parallel.data) * int(parallel.fsdp)
+
+
 def use_mesh(mesh: Mesh):
     """Ambient-mesh context manager, across jax API renames.
 

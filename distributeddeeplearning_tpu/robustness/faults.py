@@ -34,6 +34,19 @@ Kinds
   save), garbage the newest committed checkpoint's files on disk, leaving
   its commit marker intact so it still *looks* restorable; exercises the
   restore path's quarantine-and-fall-back.
+- ``host_lost@N``   — a permanently-dead host: SIGKILL to self *after
+  removing this process's heartbeat file*. A plain ``sigkill`` leaves a
+  fresh heartbeat behind (the process was alive moments ago — a transient
+  crash); a lost host's heartbeat vanishes with the host. The elastic
+  membership controller (``launch.py --elastic``) must tell the two apart
+  from the heartbeat evidence alone and re-form at the surviving degree
+  instead of burning the restart budget retrying a dead rank.
+- ``host_rejoin@N`` — the counterpart: after step N, touch the rejoin
+  marker in the heartbeat directory, exactly as a repaired host's launcher
+  would, then keep training. The elastic controller stops the job at the
+  next step boundary (graceful preemption save) and re-forms at the grown
+  degree. Fired from a *surviving* process — the dead host has no process
+  to fire from.
 
 Qualifiers (colon-separated, any order): ``aK`` — fire only on restart
 attempt K (the launcher's ``run_with_restarts`` exports the attempt index as
@@ -64,12 +77,13 @@ ALWAYS = -1  # Fault.attempt sentinel: fire on every restart attempt
 
 KINDS = frozenset({
     "crash", "sigterm", "sigkill", "nan_grads", "loader_stall",
-    "corrupt_latest_ckpt",
+    "corrupt_latest_ckpt", "host_lost", "host_rejoin",
 })
 # Faults the train loop fires between steps (vs nan_grads: compiled into the
 # step; loader_stall: injected into the data source).
 _PROCESS_KINDS = frozenset({
-    "crash", "sigterm", "sigkill", "corrupt_latest_ckpt"})
+    "crash", "sigterm", "sigkill", "corrupt_latest_ckpt",
+    "host_lost", "host_rejoin"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,6 +299,38 @@ def _fire_one(fault: Fault, step: int, ckpt, checkpoint_dir) -> None:
               file=sys.stderr, flush=True)
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.kind == "host_lost":
+        import signal
+
+        from distributeddeeplearning_tpu.observability import health
+
+        # A lost host takes its heartbeat with it: suppress the file FIRST,
+        # then die with no cleanup. The launcher's membership controller
+        # must attribute host-loss from the missing heartbeat — the kill
+        # itself looks exactly like a transient sigkill.
+        hb = health.HeartbeatWriter.from_env()
+        if hb is not None:
+            try:
+                os.remove(hb.path)
+            except OSError:
+                pass
+        print(f"# fault injection: host lost after step {step} "
+              f"(heartbeat suppressed, SIGKILL to self)",
+              file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.kind == "host_rejoin":
+        from distributeddeeplearning_tpu.observability import health
+
+        directory = os.environ.get(health.ENV_HEARTBEAT_DIR)
+        if directory:
+            health.announce_rejoin(directory)
+            print(f"# fault injection: host rejoin announced after step "
+                  f"{step}", file=sys.stderr, flush=True)
+        else:
+            print(f"# fault injection: host_rejoin@{step} ignored — no "
+                  f"{health.ENV_HEARTBEAT_DIR} (not under a heartbeat-"
+                  f"armed launcher)", file=sys.stderr, flush=True)
     elif fault.kind == "crash":
         if ckpt is not None:
             ckpt.wait()

@@ -124,6 +124,20 @@ class Checkpointer:
             # Gather-on-save: persist the canonical (mode/degree-agnostic)
             # optimizer-state layout.
             state = self._converter.to_canonical(state)
+        if jax.default_backend() == "cpu":
+            # Async-save snapshot safety — the save-side mirror of the
+            # restore hazard device_copy guards in train/loop.py: on CPU
+            # the checkpoint machinery's "device-to-host transfer" is a
+            # zero-copy view of the live buffers, and the training loop
+            # donates those same buffers to the next step. A cadence save
+            # can then serialize already-overwritten memory in the
+            # background thread (observed: garbage `step` scalars and
+            # poisoned params in every non-final save of a multi-process
+            # CPU run; only the final save — fenced by wait() — was
+            # intact). Snapshot first: the copy's buffers belong to this
+            # save alone. Accelerator backends do a real device-to-host
+            # copy, so they skip the extra pass.
+            state = device_copy(state)
         return self._mgr.save(step, args=ocp.args.StandardSave(state))
 
     # --- corrupt-step quarantine + fallback --------------------------------
@@ -378,7 +392,8 @@ class Checkpointer:
             step=jnp.asarray(restored["step"], jnp.int32),
             params=params, batch_stats=batch_stats, ema_params=ema)
 
-    def verify_or_record_stream_meta(self, meta: dict) -> None:
+    def verify_or_record_stream_meta(self, meta: dict,
+                                     update: Optional[dict] = None) -> dict:
         """Pin environment-dependent data-stream facts (e.g. the resolved
         ``auto`` loader) to the checkpoint directory.
 
@@ -387,6 +402,13 @@ class Checkpointer:
         shuffle order differs) fails loudly instead of silently feeding a
         different sample stream than the one the checkpoint was trained on
         (ADVICE r1 #1). Pass the loader explicitly to override.
+
+        ``update`` keys are INFORMATIONAL: recorded and rewritten every run,
+        never clash-checked. The elastic launcher uses this for
+        ``mesh_degree`` — the degree legitimately changes across a
+        re-formation, but the loop wants the previous run's value to report
+        a cross-degree resume. Returns the previously recorded dict (empty
+        on a fresh directory), read BEFORE this run's rewrite.
         """
         # Multi-host: agree BEFORE touching the file. Only process 0 writes,
         # so on a heterogeneous pod a non-zero process that resolved a
@@ -394,8 +416,10 @@ class Checkpointer:
         # races ahead of process 0's write (VERDICT r2 Weak #6). A collective
         # fingerprint comparison enforces the within-run invariant directly;
         # the file then only carries it across runs.
-        self._assert_uniform_across_processes(meta)
+        full = dict(meta, **(update or {}))
+        self._assert_uniform_across_processes(full)
         path = os.path.join(self._mgr.directory, "stream_meta.json")
+        recorded: dict = {}
         if os.path.exists(path):
             with open(path) as f:
                 recorded = json.load(f)
@@ -411,11 +435,14 @@ class Checkpointer:
                     "change the post-resume sample stream. Set the field "
                     "explicitly (e.g. --loader) to match the original run, "
                     "or start a fresh checkpoint_dir.")
-        elif jax.process_index() == 0:
+        if jax.process_index() == 0 and (not recorded
+                                         or any(recorded.get(k) != v
+                                                for k, v in full.items())):
             tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
-                json.dump(meta, f)
+                json.dump(dict(recorded, **full), f)
             os.replace(tmp, path)
+        return recorded
 
     @staticmethod
     def _assert_uniform_across_processes(meta: dict) -> None:

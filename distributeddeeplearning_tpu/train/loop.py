@@ -417,6 +417,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     fault_plan.validate(total_steps, checkpoint_dir=config.checkpoint_dir)
     start_step = 0
     resolved_loader = datalib.resolve_loader(config, spec.input_kind)
+    live_degree = meshlib.data_parallel_degree(config.parallel)
+    prior_meta: dict = {}
     if ckpt is not None:
         # Pin the environment-dependent loader resolution to the checkpoint:
         # a resume that would silently switch pipelines (different shuffle
@@ -427,8 +429,18 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # interchangeable across optimizer-sharding modes and DP degrees. A
         # future layout change would clash here loudly instead of silently
         # mis-restoring.
-        ckpt.verify_or_record_stream_meta({"loader": resolved_loader,
-                                           "opt_state_layout": "canonical"})
+        # global_batch_size is the fixed point of elastic re-formation: the
+        # DEGREE may change between attempts (mesh_degree below is
+        # informational, rewritten each run), but the global batch must not
+        # — gradients are allreduce-means, so a fixed batch keeps the
+        # trajectory bitwise across degrees, while a changed batch silently
+        # changes the optimization problem. Eval-only consumers are exempt
+        # (they feed no optimizer).
+        meta = {"loader": resolved_loader, "opt_state_layout": "canonical"}
+        if not restore_for_eval:
+            meta["global_batch_size"] = int(config.global_batch_size)
+        prior_meta = ckpt.verify_or_record_stream_meta(
+            meta, update={"mesh_degree": live_degree})
     if ckpt is not None and config.resume:
         # restore_for_eval: params/BN/step only, fresh optimizer state — an
         # eval-only consumer must not have to repeat the training run's
@@ -436,19 +448,40 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         restored = (ckpt.restore_latest_for_eval(state) if restore_for_eval
                     else ckpt.restore_latest(state))
         if restored is not None:
-            state = restored
-            _aot = getattr(train_step, "aot", None)
-            if _aot is not None and _aot.enabled:
-                # Warm-restart donation safety: on CPU, orbax-restored
-                # arrays can ALIAS host memory the restore machinery owns
-                # (zero-copy device_put). jit refuses to donate such
-                # buffers; a directly-called AOT executable (perf/aot.py)
-                # donates unconditionally — glibc heap corruption
-                # (SIGSEGV/SIGABRT) a few steps into every warm restart.
-                # One bitwise-identical device copy breaks the alias so the
-                # donated buffers are XLA-owned, like a fresh init's.
-                state = ckptlib.device_copy(state)
+            # Warm-restart aliasing safety, for EVERY restore: on CPU,
+            # orbax-restored arrays can ALIAS host memory the restore
+            # machinery owns (zero-copy device_put). A step that donates
+            # them then produces outputs aliasing memory orbax later frees
+            # and reuses — the live state (and every checkpoint saved from
+            # it) silently turns to garbage a few steps into the resumed
+            # run. Observed through the plain jit path too, not just a
+            # directly-called AOT executable (perf/aot.py), so the copy is
+            # unconditional: one bitwise-identical device copy breaks the
+            # alias and the buffers are XLA-owned, like a fresh init's.
+            state = ckptlib.device_copy(restored)
             start_step = int(jax.device_get(state.step))
+            prior_degree = prior_meta.get("mesh_degree")
+            if (prior_degree is not None
+                    and int(prior_degree) != live_degree):
+                # Elastic cross-degree resume (launch.py --elastic): the
+                # checkpoint was written at another DP degree; the
+                # converter's canonical layout already restored it bitwise
+                # onto THIS mesh. Loud, because a degree change outside
+                # elastic mode is operator error worth noticing.
+                if jax.process_index() == 0:
+                    print(f"# elastic: resumed a degree-{prior_degree} "
+                          f"checkpoint onto a degree-{live_degree} mesh "
+                          f"(canonical layout; global batch unchanged)",
+                          file=sys.stderr, flush=True)
+                telemetry.get().instant(
+                    "elastic:cross_degree_resume", step=start_step,
+                    degree_before=int(prior_degree),
+                    degree_after=live_degree)
+    # The membership event of a re-formed elastic attempt (exported by the
+    # launcher as DDL_ELASTIC_EVENT): detect_t is CLOCK_MONOTONIC at fault
+    # detection, the same clock telemetry.now_s() reads in this process, so
+    # the first post-resume step closes the reconfiguration_time_s span.
+    elastic_event = health.read_elastic_event()
     # Source is created here — after restore — so a real (streaming) pipeline
     # starts at the resume step rather than replaying from zero. A run with
     # no steps left skips pipeline construction entirely.
@@ -595,6 +628,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     time_to_first_step_s: Optional[float] = None
     compile_pending: Optional[float] = None
     overlap_frac: Optional[float] = None
+    reconfig_time_s: Optional[float] = None
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
@@ -646,6 +680,16 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                            step=int(i))
                 tele.gauge("time_to_first_step_s",
                            round(time_to_first_step_s, 3), step=int(i))
+                if elastic_event is not None and isinstance(
+                        elastic_event.get("detect_t"), (int, float)):
+                    # Reconfiguration span: launcher-side fault detection ->
+                    # this first post-resume step, both ends on the shared
+                    # local CLOCK_MONOTONIC. Covers teardown, relaunch,
+                    # restore, and recompile — the operator-visible outage.
+                    reconfig_time_s = (telemetry.now_s()
+                                       - float(elastic_event["detect_t"]))
+                    tele.gauge("reconfiguration_time_s",
+                               round(reconfig_time_s, 3), step=int(i))
                 if tele.enabled and getattr(train_step, "zero_stage", None):
                     # Backward/collective overlap gauge: fraction of the
                     # step's reduce-scatter spans issued INSIDE backward
@@ -766,6 +810,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if compile_time_s is not None:
         summary["compile_time_s"] = round(compile_time_s, 3)
         summary["time_to_first_step_s"] = round(time_to_first_step_s, 3)
+    if elastic_event is not None:
+        summary["elastic_event"] = {
+            k: elastic_event.get(k)
+            for k in ("trigger", "degree_before", "degree_after")}
+        if reconfig_time_s is not None:
+            summary["reconfiguration_time_s"] = round(reconfig_time_s, 3)
+        _write_elastic_sidecar(elastic_event, reconfig_time_s, start_step)
     if getattr(train_step, "zero_stage", None) is not None:
         summary["optimizer_sharding"] = {
             "stage": train_step.zero_stage,
@@ -925,6 +976,40 @@ def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
                 getattr(config, "opt_state_offload", False)),
             "dp": config.parallel.data * config.parallel.fsdp,
             "model": config.model,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(info, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _elastic_sidecar_path() -> str:
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".cache", "last_elastic_event.json")
+
+
+def _write_elastic_sidecar(event, reconfig_time_s, resume_step) -> None:
+    """Record the re-formation this attempt resumed under where
+    tools/doctor.py looks (best-effort, like the sharding sidecar)."""
+    if jax.process_index() != 0:
+        return
+    try:
+        import json
+        import os
+        path = _elastic_sidecar_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        info = {
+            "trigger": event.get("trigger"),
+            "degree_before": event.get("degree_before"),
+            "degree_after": event.get("degree_after"),
+            "reconfiguration_time_s": (round(reconfig_time_s, 3)
+                                       if reconfig_time_s is not None
+                                       else None),
+            "resume_step": int(resume_step),
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:

@@ -50,6 +50,9 @@ def pytest_runtest_logreport(report):
         # CPU-proxy gate actually ran in this tier-1 pass (a gate that
         # silently fell out of the selection is no gate).
         "perf_gate": "perf_gate" in report.keywords,
+        # elastic likewise: tools/marker_audit.py --expect-elastic verifies
+        # a fast cross-degree resume test survived in tier-1.
+        "elastic": "elastic" in report.keywords,
     })
 
 

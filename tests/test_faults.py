@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from distributeddeeplearning_tpu import launch
+from distributeddeeplearning_tpu.observability import health
 from distributeddeeplearning_tpu.robustness import faults
 
 
@@ -341,6 +342,163 @@ def test_bench_chaos_rejects_bad_fail_step(capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["metric"] == "chaos_recovery_overhead"
     assert rec["value"] is None and "chaos-fail-at" in rec["error"]
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership (launch.py --elastic)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_parse_plan_elastic_kinds():
+    plan = faults.parse_plan("host_lost@4,host_rejoin@8:a1,host_lost@2:always")
+    assert [(f.kind, f.step) for f in plan] == [
+        ("host_lost", 4), ("host_rejoin", 8), ("host_lost", 2)]
+    assert plan[0].attempt == 0          # default: first attempt only
+    assert plan[1].attempt == 1          # fires on the SHRUNKEN attempt
+    assert plan[2].attempt == faults.ALWAYS
+    with pytest.raises(ValueError):
+        faults.parse_plan("host_lost@0")
+    # Neither kind needs a checkpoint dir to validate.
+    faults.FaultPlan(tuple(plan)).validate(10, checkpoint_dir=None)
+
+
+@pytest.mark.core
+def test_attribute_failure_partition(tmp_path):
+    hb = str(tmp_path)
+    # Watchdog verdict dominates: the process was killed WHILE alive.
+    assert launch.attribute_failure(hb, 0, hung=True, ever_beat=True) == \
+        "hung"
+    # Beat once, file gone with the process: the host took its filesystem
+    # presence with it.
+    assert launch.attribute_failure(hb, 0, ever_beat=True) == "host_lost"
+    # Beat once, file still there: transient crash, host is fine.
+    (tmp_path / "heartbeat.1").write_text("{}")
+    assert launch.attribute_failure(hb, 1, ever_beat=True) == "crash"
+    # Never armed / never beat: no evidence, default to crash.
+    assert launch.attribute_failure(hb, 2, ever_beat=False) == "crash"
+    assert launch.attribute_failure(None, 0, ever_beat=True) == "crash"
+
+
+@pytest.mark.core
+def test_with_flag_value():
+    f = launch._with_flag_value
+    assert f(["train.py", "--dp", "4", "--steps", "8"], "--dp", "2") == \
+        ["train.py", "--dp", "2", "--steps", "8"]
+    assert f(["train.py", "--dp=4"], "--dp", "2") == ["train.py", "--dp=2"]
+    assert f(["train.py", "--steps", "8"], "--dp", "2") == \
+        ["train.py", "--steps", "8", "--dp", "2"]
+
+
+@pytest.mark.core
+def test_elastic_controller_shrink_remaps_slots(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    with pytest.raises(ValueError, match="divide evenly"):
+        launch.ElasticController(3, hb, base_dp=8)
+    ctl = launch.ElasticController(4, hb, base_dp=8)
+    assert (ctl.num_processes, ctl.degree) == (4, 8)
+    base = {0: {"X": "h0"}, 2: {"X": "h2"}}
+    env0 = ctl.child_env(base)
+    assert env0[2]["X"] == "h2" and health.ENV_ELASTIC_EVENT not in env0[0]
+
+    # Host 2 dies taking its heartbeat with it (slot 2 == host 2 here).
+    assert ctl.note_failure(2, -9, ever_beat=True) == "host_lost"
+    assert ctl.live == [0, 1, 3] and ctl.degree == 6
+    event = ctl.take_reconfiguration()
+    assert (event["trigger"], event["degree_before"],
+            event["degree_after"]) == ("host_lost", 8, 6)
+    assert ctl.take_reconfiguration() is None  # consumed
+
+    # Re-formed attempt: --dp rewritten, fault plans follow the ORIGINAL
+    # host id (host 2's env died with it; host 3 now sits in slot 2), and
+    # every slot carries the membership event — exactly once.
+    assert ctl.command(["train.py", "--dp", "8"]) == ["train.py", "--dp", "6"]
+    env1 = ctl.child_env(base)
+    assert set(env1) == {0, 1, 2}
+    assert env1[0]["X"] == "h0" and "X" not in env1[2]
+    for slot in env1:
+        evt = json.loads(env1[slot][health.ENV_ELASTIC_EVENT])
+        assert evt["trigger"] == "host_lost" and "detect_t" in evt
+    env2 = ctl.child_env(base)
+    assert health.ENV_ELASTIC_EVENT not in env2[0]
+
+
+@pytest.mark.core
+def test_elastic_controller_rejoin_grows_back(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    ctl = launch.ElasticController(2, hb, base_dp=4)
+    # Rejoin marker with nobody missing: consumed, ignored.
+    health.announce_rejoin(hb)
+    assert ctl.poll_rejoin() is False
+    assert ctl.poll_rejoin() is False  # marker actually consumed
+
+    ctl.note_failure(1, -9, ever_beat=True)
+    assert ctl.degree == 2
+    assert ctl.take_reconfiguration()["trigger"] == "host_lost"
+    health.announce_rejoin(hb)
+    assert ctl.poll_rejoin() is True
+    assert ctl.live == [0, 1] and ctl.degree == 4
+    event = ctl.take_reconfiguration()
+    assert (event["trigger"], event["degree_before"],
+            event["degree_after"]) == ("host_rejoin", 2, 4)
+    assert ctl.events and len(ctl.events) == 2
+
+
+@pytest.mark.core
+def test_elastic_controller_min_hosts_gives_up(tmp_path, capsys):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    ctl = launch.ElasticController(2, hb, base_dp=4, min_hosts=2)
+    ctl.note_failure(0, -9, ever_beat=True)
+    assert ctl.take_reconfiguration() is None
+    assert "cannot re-form, giving up" in capsys.readouterr().err
+
+
+@pytest.mark.core
+def test_run_with_restarts_reconfiguration_skips_backoff(capsys):
+    """The satellite contract, pinned on the delay schedule: a planned
+    re-formation relaunches with NO backoff sleep and NO restart-budget
+    charge, while an ordinary crash in the same job still backs off."""
+    class _Stub:
+        def __init__(self):
+            self.queue = [
+                {"trigger": "host_lost", "degree_before": 4,
+                 "degree_after": 2}, None, None]
+
+        def take_reconfiguration(self):
+            return self.queue.pop(0)
+
+    sleeps, calls = [], []
+
+    def run_once():
+        calls.append(1)
+        # attempt 0: host loss; attempt 1: plain crash; attempt 2: done.
+        return 1 if len(calls) < 3 else 0
+
+    rc = launch.run_with_restarts(run_once, 1, backoff_s=1.0,
+                                  backoff_cap_s=10.0, sleep=sleeps.append,
+                                  elastic=_Stub())
+    assert rc == 0 and len(calls) == 3
+    # Exactly ONE backoff (the crash); the re-formation slept zero. And the
+    # budget of 1 survived because the re-formation never charged it.
+    assert sleeps == [launch._backoff_delay(1, 1.0, 10.0)]
+    err = capsys.readouterr().err
+    assert "elastic re-formation (host_lost): degree 4 -> 2" in err
+    assert "no backoff, budget untouched" in err
+
+
+@pytest.mark.core
+def test_run_with_restarts_ctrl_c_beats_reconfiguration():
+    """^C stops the job even with a re-formation pending — the operator
+    always outranks the controller."""
+    class _Stub:
+        def take_reconfiguration(self):  # pragma: no cover - must not run
+            raise AssertionError("consulted elastic controller on rc=130")
+
+    rc = launch.run_with_restarts(lambda: 130, 5, sleep=lambda s: None,
+                                  elastic=_Stub())
+    assert rc == 130
 
 
 # ---------------------------------------------------------------------------

@@ -131,3 +131,52 @@ def test_cli_expect_perf_gate_flag(tmp_path):
                           text=True)
     assert proc.returncode == 1
     assert "BOTH perf_gate and slow" in proc.stdout
+
+
+# --- elastic coverage audit (ISSUE 9 satellite) -----------------------------
+
+from tools.marker_audit import audit_elastic  # noqa: E402
+
+
+def test_audit_elastic_clean_run():
+    records = [_rec("t::fast", 1.0),
+               {**_rec("t::fast_cross_degree", 20.0), "elastic": True},
+               {**_rec("t::soak", 300.0, slow=True), "elastic": True}]
+    assert audit_elastic(records) == []
+
+
+def test_audit_elastic_flags_no_coverage():
+    problems = audit_elastic([_rec("t::fast", 1.0)])
+    assert len(problems) == 1
+    assert "no elastic-marked test ran" in problems[0]
+
+
+def test_audit_elastic_flags_all_slow():
+    """The soak is legitimately slow, but if EVERY elastic test is slow the
+    cross-degree resume path silently leaves tier-1 (-m 'not slow')."""
+    records = [{**_rec("t::soak", 300.0, slow=True), "elastic": True}]
+    problems = audit_elastic(records)
+    assert len(problems) == 1
+    assert "every elastic-marked test is also marked slow" in problems[0]
+
+
+def test_cli_expect_elastic_flag(tmp_path):
+    cmd = [sys.executable, "tools/marker_audit.py"]
+    no_elastic = tmp_path / "no_elastic.json"
+    no_elastic.write_text(json.dumps([_rec("t::fast", 1.0)]))
+    # Entirely opt-in: partial runs stay quiet...
+    assert subprocess.run(cmd + [str(no_elastic)]).returncode == 0
+    # ...the tier-1 chain opts in and fails loudly.
+    proc = subprocess.run(cmd + [str(no_elastic), "--expect-elastic"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "no elastic-marked test ran" in proc.stdout
+    # Both flags compose on one invocation.
+    full = tmp_path / "full.json"
+    full.write_text(json.dumps(
+        [{**_rec("t::gate", 5.0), "perf_gate": True},
+         {**_rec("t::gate_zero2_overlap", 5.0), "perf_gate": True},
+         {**_rec("t::fast_cross_degree", 20.0), "elastic": True}]))
+    assert subprocess.run(
+        cmd + [str(full), "--expect-perf-gate", "--expect-elastic"],
+    ).returncode == 0

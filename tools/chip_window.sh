@@ -269,6 +269,18 @@ if [ "${DDL_CHAOS:-0}" = "1" ]; then
   note chaos
 fi
 
+# 12b. Elastic re-formation soak (gated, OFF by default, same reasoning as
+# the chaos step: CPU-only, ask with DDL_ELASTIC=1). A 2-host dp4
+# transformer job loses a host (host_lost), auto-shrinks to dp2, grows
+# back to dp4 on rejoin, and records the measured reconfiguration_time_s
+# (fault detection -> first post-resume step; docs/fault_tolerance.md).
+if [ "${DDL_ELASTIC:-0}" = "1" ]; then
+  check_stop elastic
+  timeout 900 env JAX_PLATFORMS=cpu python bench.py --chaos-elastic \
+    > "$RES/elastic_recovery.json" 2>> "$RES/log.txt"
+  note elastic
+fi
+
 # --- Gated cold-vs-warm start A/B (ask with DDL_COLDSTART=1) --------------
 # Same headline config twice: once against a private EMPTY compile cache
 # (true cold start: full trace + XLA compile) and once against the shared

@@ -251,6 +251,30 @@ def check_sharding() -> None:
                   f"written by the first train run")
 
 
+def check_elastic() -> None:
+    """Last elastic re-formation (loop.py drops
+    .cache/last_elastic_event.json on process 0 when a run resumes under a
+    launch.py --elastic membership event): trigger (host_lost / hung /
+    host_rejoin), degree before/after, the measured reconfiguration
+    seconds, and the resume step — so "what did the last re-formation
+    cost?" is answerable from doctor output. ok=True always: an absent
+    sidecar just means no elastic re-formation has happened yet."""
+    path = os.path.join(REPO, ".cache", "last_elastic_event.json")
+    try:
+        with open(path) as fh:
+            side = json.load(fh)
+        if not isinstance(side, dict):
+            raise ValueError("sidecar is not a JSON object")
+        emit("elastic", ok=True,
+             **{k: side.get(k) for k in (
+                 "trigger", "degree_before", "degree_after",
+                 "reconfiguration_time_s", "resume_step")})
+    except (OSError, ValueError) as e:
+        emit("elastic", ok=True, last_event=None,
+             note=f"no elastic sidecar ({e.__class__.__name__}); written "
+                  f"when a launch.py --elastic run re-forms")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-timeout", type=int, default=45)
@@ -267,6 +291,7 @@ def main(argv=None) -> int:
     check_caches(prune_days=args.prune)
     check_perf_gate()
     check_sharding()
+    check_elastic()
     return 0
 
 

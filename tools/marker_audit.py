@@ -83,15 +83,42 @@ def audit_perf_gate(records) -> list[str]:
     return problems
 
 
+def audit_elastic(records) -> list[str]:
+    """Problems with elastic-resume coverage in this run.
+
+    The cross-degree resume path (tests marked ``elastic``) has the same
+    silent-disarm failure modes as the perf gate: the marked tests vanish
+    from the selection, or every one of them is also marked ``slow`` and
+    tier-1's ``-m 'not slow'`` filters elastic coverage out entirely (the
+    soak is legitimately slow — but a FAST variant must survive in
+    tier-1; tests/test_elastic_resume.py keeps one)."""
+    problems = []
+    elastic = [r for r in records if r.get("elastic")]
+    if not elastic:
+        problems.append(
+            "no elastic-marked test ran — the cross-degree resume path is "
+            "untested in this run (tests/test_elastic_resume.py missing, "
+            "renamed, or deselected?)")
+    elif all(r.get("slow") for r in elastic):
+        problems.append(
+            "every elastic-marked test is also marked slow — tier-1 runs "
+            "-m 'not slow', so the cross-degree resume path is silently "
+            "untested in tier-1 (keep a fast elastic variant unmarked)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
-              f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate]")
+              f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
+              f"[--expect-elastic]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
-    argv = [a for a in argv if a != "--expect-perf-gate"]
+    expect_elastic = "--expect-elastic" in argv
+    argv = [a for a in argv
+            if a not in ("--expect-perf-gate", "--expect-elastic")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -109,6 +136,10 @@ def main(argv=None) -> int:
         gate_problems = [p for p in gate_problems
                          if not p.startswith(("no perf_gate",
                                               "perf_gate tests ran but"))]
+    # Elastic coverage is entirely opt-in (both of its problems are
+    # presence checks, meaningless on partial runs).
+    if expect_elastic:
+        gate_problems += audit_elastic(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

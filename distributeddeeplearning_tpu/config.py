@@ -177,6 +177,16 @@ class TrainConfig:
                                   # whole run (the ring buffer bounds
                                   # memory either way)
     trace_max_events: int = 200_000  # telemetry ring-buffer capacity
+    flight_dir: Optional[str] = None  # flight recorder (observability/
+                                  # flight.py): crash-surviving fsync'd
+                                  # JSONL event log, one file per host.
+                                  # None = the launcher-exported
+                                  # DDL_FLIGHT_DIR, else disabled
+    anomaly_detection: bool = True  # online anomaly detector (observability/
+                                  # anomaly.py) over the chief's log-cadence
+                                  # records: loss spikes, grad-norm drift,
+                                  # throughput collapse, straggler trending.
+                                  # Host-side medians only — no device cost
     straggler_threshold: float = 1.5  # multi-host only: warn when a host's
                                   # log-cadence step_time exceeds this x the
                                   # cross-host mean (observability/

@@ -40,6 +40,7 @@ import sys
 import time
 from typing import Callable, Optional, Sequence
 
+from distributeddeeplearning_tpu.observability import flight as flightlib
 from distributeddeeplearning_tpu.observability import health, telemetry
 from distributeddeeplearning_tpu.robustness import faults
 
@@ -280,6 +281,10 @@ class ElasticController:
 
     def _plan(self, trigger: str, degree_before: int) -> None:
         now = telemetry.now_s()
+        flightlib.get().record("membership", trigger=trigger,
+                               degree_before=degree_before,
+                               degree_after=self.degree,
+                               live_hosts=list(self.live))
         if self._pending is None:
             self._pending = {"trigger": trigger,
                              "degree_before": degree_before,
@@ -358,6 +363,8 @@ def monitor(children: Sequence[subprocess.Popen], *,
                         if tele is not None:
                             tele.instant("launcher:heartbeat_stale",
                                          child=idx, age_s=round(age, 1))
+                        flightlib.get().record("heartbeat_stale", child=idx,
+                                               age_s=round(age, 1))
                         hung.add(idx)
                         procs[idx].kill()
             if elastic is not None and elastic.poll_rejoin():
@@ -371,6 +378,7 @@ def monitor(children: Sequence[subprocess.Popen], *,
                       file=sys.stderr, flush=True)
                 if tele is not None:
                     tele.instant("launcher:host_rejoin")
+                flightlib.get().record("host_rejoin")
                 _terminate_all(procs, max(grace_s, 30.0))
                 return 1
             codes = [p.poll() for p in procs]
@@ -383,6 +391,7 @@ def monitor(children: Sequence[subprocess.Popen], *,
                 for idx, c in failed:
                     why = f" (killed by signal {-c})" if c < 0 else ""
                     attributed = ""
+                    label = None
                     if heartbeat_dir is not None:
                         if elastic is not None:
                             label = elastic.note_failure(
@@ -396,6 +405,8 @@ def monitor(children: Sequence[subprocess.Popen], *,
                         if tele is not None:
                             tele.instant("launcher:failure_attributed",
                                          child=idx, attribution=label)
+                    flightlib.get().record("child_exit", child=idx,
+                                           rc=int(c), attribution=label)
                     print(f"# launcher: child {idx} exited rc={c}{why}"
                           f"{attributed}", file=sys.stderr, flush=True)
                 survivors = sum(1 for c in codes if c is None)
@@ -540,6 +551,8 @@ def run_with_restarts(run_once, max_restarts: int, *,
             if tele is not None:
                 tele.instant("launcher:attempt_failed", rc=rc,
                              attempt=total - 1)
+            flightlib.get().record("attempt_failed", rc=rc,
+                                   attempt=total - 1)
             if rc == 130:
                 # ^C is ALWAYS an operator stop, even mid-reconfiguration.
                 print(f"# launcher: operator stop (rc={rc}); not retrying",
@@ -560,6 +573,13 @@ def run_with_restarts(run_once, max_restarts: int, *,
                                      trigger=event["trigger"],
                                      degree_before=event["degree_before"],
                                      degree_after=event["degree_after"])
+                    # The loop records "reconfiguration" when the re-formed
+                    # attempt lands its first step; this is the plan side.
+                    flightlib.get().record(
+                        "reconfiguration_planned",
+                        trigger=event["trigger"],
+                        degree_before=event["degree_before"],
+                        degree_after=event["degree_after"])
                     if progress_fn is not None:
                         # A re-formed attempt starts a fresh progress
                         # window — don't let the pre-shrink baseline
@@ -585,12 +605,17 @@ def run_with_restarts(run_once, max_restarts: int, *,
                           f"consecutive restarts (budget={max_restarts}) — "
                           "crash loop, giving up",
                           file=sys.stderr, flush=True)
+                flightlib.get().record("giving_up", rc=rc,
+                                       restarts=window_used)
                 return rc
             window_used += 1
             delay = _backoff_delay(window_used, backoff_s, backoff_cap_s)
             if tele is not None:
                 tele.instant("launcher:restart", attempt=total,
                              restart=window_used, backoff_s=round(delay, 2))
+            flightlib.get().record("restart", attempt=total,
+                                   restart=window_used,
+                                   backoff_s=round(delay, 2))
             print(f"# launcher: job failed (rc={rc}); restart "
                   f"{window_used}/{max_restarts} in {delay:.1f}s "
                   f"(resumes from the latest checkpoint)",
@@ -671,6 +696,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--min-hosts", type=int, default=1,
                    help="with --elastic, give up (generic failure path) "
                         "instead of re-forming below this many hosts")
+    p.add_argument("--flight-dir", default=None,
+                   help="flight recorder directory (observability/"
+                        "flight.py): the launcher mints one run id for the "
+                        "whole job, exports it to every child of every "
+                        "restart attempt, and appends its own spawn/"
+                        "attribution/restart events — the crash-surviving "
+                        "record tools/postmortem.py reads. Default: the "
+                        "training command's own --flight-dir, else off")
     p.add_argument("--compile-cache-dir", default=None,
                    help="persistent compile cache shared by every child and "
                         "every restart attempt (docs/compile_cache.md); "
@@ -753,6 +786,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             prefix="ddl_heartbeat_")
         os.makedirs(heartbeat_dir, exist_ok=True)
 
+    # Flight recorder (observability/flight.py): ONE run id for the whole
+    # job, minted here and exported so every child of every restart attempt
+    # appends to the same run's record under the shared identity scheme.
+    # The launcher writes its own file (child exits, attribution verdicts,
+    # restarts, re-formations) — the events that survive even when a child
+    # died too fast to record anything.
+    flight_dir = (args.flight_dir if args.flight_dir is not None
+                  else _flag_from_command(command, "--flight-dir"))
+    if flight_dir is not None:
+        os.environ[flightlib.ENV_FLIGHT_DIR] = flight_dir
+        os.environ.setdefault(flightlib.ENV_RUN_ID, flightlib.mint_run_id())
+        flight = flightlib.configure(
+            flight_dir, run_id=os.environ[flightlib.ENV_RUN_ID],
+            host="launcher")
+        flight.record("launch", num_processes=n,
+                      max_restarts=args.max_restarts,
+                      elastic=bool(args.elastic),
+                      command=" ".join(command))
+    else:
+        flight = flightlib.get()
+
     # When the training command traces (--trace-dir), the launcher records
     # its restart/backoff/stale-heartbeat instants too and merges them into
     # process 0's trace AFTER the job ends — one Chrome-trace file then
@@ -820,6 +874,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr, flush=True)
     if tele is not None:
         tele.export(telemetry.trace_path(trace_dir, 0))
+    flight.record("job_end", rc=rc)
+    flight.close()
     return rc
 
 

@@ -1,16 +1,27 @@
-"""Unified observability: always-on phase telemetry, heartbeat health.
+"""Unified observability: telemetry, flight record, metrics, health.
 
-Three modules, split by import weight:
+Modules, split by import weight:
 
 - :mod:`.telemetry` — thread-safe span/counter/gauge registry over a
   bounded ring buffer, exportable as Chrome-trace JSON. Pure stdlib, so
   the jax-free launcher and the data/robustness layers import it freely.
+- :mod:`.flight` — crash-surviving fsync'd JSONL event log (the flight
+  recorder) with a shared run/attempt/host identity scheme. Pure stdlib.
+- :mod:`.metrics` — cross-host gauge registry with Prometheus-text and
+  JSON-snapshot export. Pure stdlib.
+- :mod:`.anomaly` — online detector (loss spikes, grad-norm drift,
+  throughput collapse, straggler trending) over the log-cadence metric
+  stream. Pure stdlib.
+- :mod:`.sidecars` — the one read/write helper behind every
+  ``.cache/*.json`` run sidecar. Pure stdlib.
 - :mod:`.health` — heartbeat files (child-side writer, launcher-side
-  staleness check). Pure stdlib for the same reason.
+  staleness check). Pure stdlib.
 - :mod:`.straggler` — cross-host step-time/data-wait aggregation on log
   cadence (imports jax; the train loop is its only consumer).
 """
 
-from distributeddeeplearning_tpu.observability import health, telemetry
+from distributeddeeplearning_tpu.observability import (
+    anomaly, flight, health, metrics, sidecars, telemetry)
 
-__all__ = ["health", "telemetry"]
+__all__ = ["anomaly", "flight", "health", "metrics", "sidecars",
+           "telemetry"]

@@ -1,0 +1,193 @@
+"""Online anomaly detection over the log-cadence metric stream.
+
+The bad-step guard (`train/loop.py`) catches the sharpest failure —
+non-finite loss/grad inside a single compiled step — but a run can rot
+in ways no single step exposes: loss quietly diverging, grad norms
+drifting orders of magnitude, throughput collapsing because one loader
+started thrashing, a straggler trending worse every log interval. This
+detector watches exactly the signals the chief already has in hand on
+the log cadence and flags four families:
+
+    loss_spike / loss_nonfinite          optimization diverging
+    grad_norm_drift / grad_norm_nonfinite  update scale off the rails
+    throughput_collapse / loader_stall   examples/sec cratered
+                                         (loader_stall when data-wait
+                                         dominates the interval)
+    straggler_trending                   one host slow for N intervals
+    bad_step                             the compiled guard tripped
+
+Design constraints, in order:
+
+1. **Zero false positives on a clean run.** Baselines are rolling
+   *medians* with MAD-scaled margins plus generous absolute floors, and
+   nothing fires until ``min_samples`` observations exist — compile
+   warm-up, checkpoint pauses and ordinary loss noise stay quiet.
+2. **Fast on real faults.** An injected loss spike or NaN flags on the
+   first or second cadence after it appears (acceptance bound: five).
+3. **Cheap.** Pure host-side Python over deques; no device fetches
+   beyond what `MetricLogger.log` already paid.
+
+``update()`` returns plain anomaly dicts; ``report()`` is the one place
+that turns them into operator-visible artifacts — flight-recorder
+events, telemetry instants, stderr warnings, and (for non-finite
+signals) bad-step-guard feedback — so the loop and tests share one
+reporting path.
+
+Pure stdlib on purpose.
+"""
+from __future__ import annotations
+
+import math
+import sys
+from collections import deque
+from statistics import median
+from typing import Any, Optional
+
+# Kinds report() feeds to the bad-step tracker. "bad_step" itself is
+# excluded: the tracker already counted the compiled flag via push() —
+# feeding it back would double-count every skip.
+FEEDS_GUARD = ("loss_nonfinite", "grad_norm_nonfinite")
+
+
+def _finite(value: Any) -> Optional[float]:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return v if math.isfinite(v) else None
+
+
+class AnomalyDetector:
+    """Rolling-median detector; one instance per run, fed on log steps."""
+
+    def __init__(self, *, window: int = 16, min_samples: int = 4,
+                 loss_margin: float = 0.5, loss_mad_k: float = 8.0,
+                 grad_drift_factor: float = 10.0,
+                 throughput_collapse_frac: float = 0.35,
+                 data_wait_dominance: float = 0.6,
+                 straggler_ratio: float = 1.5,
+                 straggler_patience: int = 3):
+        self.min_samples = int(min_samples)
+        self.loss_margin = float(loss_margin)
+        self.loss_mad_k = float(loss_mad_k)
+        self.grad_drift_factor = float(grad_drift_factor)
+        self.throughput_collapse_frac = float(throughput_collapse_frac)
+        self.data_wait_dominance = float(data_wait_dominance)
+        self.straggler_ratio = float(straggler_ratio)
+        self.straggler_patience = int(straggler_patience)
+        self._loss: deque = deque(maxlen=window)
+        self._grad: deque = deque(maxlen=window)
+        self._eps: deque = deque(maxlen=window)
+        self._straggler_streak = 0
+
+    def update(self, step: int, *, loss: Any = None, grad_norm: Any = None,
+               examples_per_sec: Any = None, data_wait_frac: Any = None,
+               straggler_ratio: Any = None,
+               bad_step: Any = None) -> list[dict]:
+        """Feed one log-cadence observation; returns flagged anomalies
+        (empty list on a healthy interval). Missing signals are skipped."""
+        out: list[dict] = []
+
+        def flag(kind: str, value: Any, baseline: Any, detail: str) -> None:
+            out.append({"kind": kind, "step": int(step),
+                        "value": value, "baseline": baseline,
+                        "detail": detail})
+
+        if loss is not None:
+            v = _finite(loss)
+            if v is None:
+                flag("loss_nonfinite", float("nan"), None,
+                     f"loss={loss!r}")
+            else:
+                if len(self._loss) >= self.min_samples:
+                    med = median(self._loss)
+                    mad = median(abs(x - med) for x in self._loss)
+                    limit = med + max(self.loss_margin,
+                                      self.loss_mad_k * mad)
+                    if v > limit:
+                        flag("loss_spike", v, med,
+                             f"loss {v:.4g} > {limit:.4g} "
+                             f"(median {med:.4g})")
+                self._loss.append(v)
+
+        if grad_norm is not None:
+            v = _finite(grad_norm)
+            if v is None:
+                flag("grad_norm_nonfinite", float("nan"), None,
+                     f"grad_norm={grad_norm!r}")
+            else:
+                if len(self._grad) >= self.min_samples:
+                    med = median(self._grad)
+                    if med > 1e-12:
+                        ratio = v / med
+                        if (ratio > self.grad_drift_factor
+                                or ratio < 1.0 / self.grad_drift_factor):
+                            flag("grad_norm_drift", v, med,
+                                 f"grad norm {v:.4g} is {ratio:.3g}x the "
+                                 f"rolling median {med:.4g}")
+                self._grad.append(v)
+
+        eps = _finite(examples_per_sec) if examples_per_sec is not None \
+            else None
+        wait = _finite(data_wait_frac) if data_wait_frac is not None \
+            else None
+        if eps is not None and eps > 0:
+            if len(self._eps) >= self.min_samples:
+                med = median(self._eps)
+                if med > 0 and eps < self.throughput_collapse_frac * med:
+                    if wait is not None and wait >= self.data_wait_dominance:
+                        flag("loader_stall", eps, med,
+                             f"throughput {eps:.4g} ex/s vs median "
+                             f"{med:.4g} with {wait:.0%} of the interval "
+                             "spent waiting on data")
+                    else:
+                        flag("throughput_collapse", eps, med,
+                             f"throughput {eps:.4g} ex/s < "
+                             f"{self.throughput_collapse_frac:.0%} of "
+                             f"median {med:.4g}")
+            self._eps.append(eps)
+
+        if straggler_ratio is not None:
+            r = _finite(straggler_ratio)
+            if r is not None and r >= self.straggler_ratio:
+                self._straggler_streak += 1
+                if self._straggler_streak >= self.straggler_patience:
+                    flag("straggler_trending", r, self.straggler_ratio,
+                         f"host step-time skew {r:.3g}x mean for "
+                         f"{self._straggler_streak} consecutive log "
+                         "intervals")
+                    self._straggler_streak = 0
+            elif r is not None:
+                self._straggler_streak = 0
+
+        if bad_step is not None:
+            b = _finite(bad_step)
+            if b is not None and b > 0:
+                flag("bad_step", b, 0.0,
+                     "compiled bad-step guard skipped a non-finite update")
+
+        return out
+
+
+def report(anomalies: list[dict], *, flight_rec: Any = None,
+           tele: Any = None, bad_tracker: Any = None,
+           stream: Any = None) -> None:
+    """Fan one ``update()`` result out to every consumer: flight record,
+    trace instants, stderr, and the bad-step guard (non-finite kinds
+    count toward its consecutive-abort limit, so a run pinned at NaN
+    aborts even when the compiled flag is not being fetched)."""
+    stream = sys.stderr if stream is None else stream
+    for a in anomalies:
+        kind, step = a["kind"], a["step"]
+        print(f"# anomaly: {kind} at step {step} — {a['detail']}",
+              file=stream, flush=True)
+        if flight_rec is not None:
+            flight_rec.record("anomaly", kind=kind, step=step,
+                              value=a.get("value"),
+                              baseline=a.get("baseline"),
+                              detail=a["detail"])
+        if tele is not None:
+            tele.instant(f"anomaly:{kind}", step=step,
+                         detail=a["detail"])
+        if bad_tracker is not None and kind in FEEDS_GUARD:
+            bad_tracker.note_anomaly()

@@ -1,0 +1,323 @@
+"""Flight recorder: a crash-surviving, append-only structured event log.
+
+The telemetry ring buffer (`telemetry.py`) is deliberately lazy — events
+live in memory and hit disk only when `export()` drains them in the
+loop's `finally`. That is the right trade for dense phase spans, and the
+wrong one for the handful of events a post-mortem actually hinges on: a
+`sigkill` (or a real preemption) destroys the unexported tail by design.
+
+The flight recorder is the other half of the trade: a *sparse* JSONL
+event log where every line is flushed and fsync'd at record time, so the
+record survives any way the process can die. Each writer (one per host,
+plus the launcher) appends to its own file in a shared directory:
+
+    <flight_dir>/flight.p0.jsonl        host 0
+    <flight_dir>/flight.p1.jsonl        host 1
+    <flight_dir>/flight.launcher.jsonl  the launcher
+
+Every event carries one shared identity scheme so records from any
+number of hosts and restart attempts merge into one run timeline:
+
+    run      run id, minted once by the launcher (DDL_RUN_ID) or by an
+             unlaunched train.py; constant across restart attempts
+    attempt  restart attempt (DDL_RESTART_ATTEMPT, 0 when unlaunched)
+    host     process index (DDL_PROCESS_ID) or "launcher"
+    seq      per-writer monotonic sequence number (tie-break + torn-tail
+             detection)
+    t        wall-clock seconds (cross-host ordering, human timestamps)
+    mono     CLOCK_MONOTONIC seconds — same clock as telemetry.now_s(),
+             shared by all processes on one host, so flight events and
+             trace instants interleave exactly
+
+Files are size-bounded ring buffers: past ``max_bytes`` the segment
+rotates to ``<name>.1`` (one previous segment kept), so a pathological
+writer is bounded at ~2x``max_bytes`` per host while the *most recent*
+window — the part a post-mortem wants — is always intact.
+
+Pure stdlib on purpose: `launch.py` and `robustness/faults.py` record
+flight events and must never import jax. Recording never raises — a
+full disk must not kill training.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Optional
+
+ENV_FLIGHT_DIR = "DDL_FLIGHT_DIR"
+ENV_RUN_ID = "DDL_RUN_ID"
+# Shared with health.py / faults.py (redeclared to stay import-light).
+_ENV_PROCESS_ID = "DDL_PROCESS_ID"
+_ENV_ATTEMPT = "DDL_RESTART_ATTEMPT"
+
+DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+
+# Event kinds a post-mortem treats as "something went wrong".
+INCIDENT_EVENTS = ("fault", "anomaly", "child_exit", "heartbeat_stale",
+                   "preempted", "abort", "giving_up")
+
+
+def mint_run_id(now: Optional[float] = None) -> str:
+    """A sortable, collision-safe run id: wall time + random suffix."""
+    now = time.time() if now is None else now
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
+    return f"run-{stamp}-{os.urandom(3).hex()}"
+
+
+def flight_path(directory: str, host: Any) -> str:
+    """``flight.p{N}.jsonl`` for rank N; ``flight.{label}.jsonl`` else."""
+    label = f"p{host}" if isinstance(host, int) else str(host)
+    return os.path.join(directory, f"flight.{label}.jsonl")
+
+
+class FlightRecorder:
+    """Append-only fsync'd JSONL writer for one host.
+
+    ``directory=None`` builds a disabled recorder: ``record()`` is a
+    cheap no-op, so call sites never branch.
+    """
+
+    def __init__(self, directory: Optional[str], *,
+                 run_id: Optional[str] = None,
+                 host: Any = 0,
+                 attempt: int = 0,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 fsync: bool = True):
+        self.enabled = directory is not None
+        self.directory = directory
+        self.run_id = run_id or mint_run_id()
+        self.host = host
+        self.attempt = int(attempt)
+        self.max_bytes = int(max_bytes)
+        self._fsync = bool(fsync)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._fh = None
+        if self.enabled:
+            try:
+                os.makedirs(directory, exist_ok=True)
+                self.path = flight_path(directory, host)
+            except OSError:
+                self.enabled = False
+                self.path = None
+        else:
+            self.path = None
+
+    @classmethod
+    def from_env(cls, *, host: Any = None,
+                 directory: Optional[str] = None) -> "FlightRecorder":
+        """Build from the launcher-exported environment.
+
+        ``directory`` (e.g. from ``--flight-dir``) overrides
+        ``$DDL_FLIGHT_DIR``; with neither set the recorder is disabled.
+        The run id comes from ``$DDL_RUN_ID`` when a launcher minted one.
+        """
+        directory = directory or os.environ.get(ENV_FLIGHT_DIR)
+        if host is None:
+            try:
+                host = int(os.environ.get(_ENV_PROCESS_ID, "0"))
+            except ValueError:
+                host = 0
+        try:
+            attempt = int(os.environ.get(_ENV_ATTEMPT, "0"))
+        except ValueError:
+            attempt = 0
+        return cls(directory, run_id=os.environ.get(ENV_RUN_ID),
+                   host=host, attempt=attempt)
+
+    # -- writing ---------------------------------------------------------
+
+    def record(self, ev: str, **fields: Any) -> None:
+        """Append one event and force it to disk. Never raises."""
+        if not self.enabled:
+            return
+        try:
+            with self._lock:
+                self._seq += 1
+                entry = {"ev": ev, "t": time.time(),
+                         "mono": time.monotonic(),
+                         "run": self.run_id, "attempt": self.attempt,
+                         "host": self.host, "seq": self._seq}
+                entry.update(fields)
+                line = json.dumps(entry, sort_keys=True,
+                                  default=_json_fallback) + "\n"
+                fh = self._open_locked()
+                fh.write(line)
+                fh.flush()
+                if self._fsync:
+                    os.fsync(fh.fileno())
+                if fh.tell() >= self.max_bytes:
+                    self._rotate_locked()
+        except Exception:  # noqa: BLE001 — recording must never kill a run
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def _open_locked(self):
+        if self._fh is None or self._fh.closed:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def _rotate_locked(self) -> None:
+        """Ring semantics: keep one previous segment, start a fresh one."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        os.replace(self.path, self.path + ".1")
+
+
+def _json_fallback(obj: Any) -> Any:
+    try:
+        return float(obj)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+# -- module singleton (telemetry-style) ----------------------------------
+
+_active = FlightRecorder(None)
+
+
+def get() -> FlightRecorder:
+    return _active
+
+
+def configure(directory: Optional[str], **kw: Any) -> FlightRecorder:
+    global _active
+    _active.close()
+    _active = FlightRecorder(directory, **kw)
+    return _active
+
+
+def configure_from_env(*, host: Any = None,
+                       directory: Optional[str] = None) -> FlightRecorder:
+    global _active
+    _active.close()
+    _active = FlightRecorder.from_env(host=host, directory=directory)
+    return _active
+
+
+def reset() -> None:
+    configure(None)
+
+
+# -- reading -------------------------------------------------------------
+
+def read_file(path: str) -> tuple[list[dict], Optional[str]]:
+    """Parse one flight file tolerantly.
+
+    A writer killed mid-line (the whole point of the recorder is that
+    writers get killed) leaves at most one torn tail line; it is skipped
+    and reported, everything before it is salvaged. Returns
+    ``(events, error)`` with ``error=None`` when the file parsed whole.
+    """
+    events: list[dict] = []
+    error = None
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            for n, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    error = f"{os.path.basename(path)}:{n}: unparseable line"
+                    continue
+                if isinstance(obj, dict):
+                    obj["_file"] = os.path.basename(path)
+                    events.append(obj)
+    except OSError as exc:
+        return [], f"{path}: {exc}"
+    return events, error
+
+
+def read_all(directory: str) -> tuple[list[dict], list[str]]:
+    """All events from every flight file (rotated segments included),
+    sorted into one timeline by ``(t, seq)``."""
+    events: list[dict] = []
+    errors: list[str] = []
+    paths = sorted(glob.glob(os.path.join(directory, "flight.*.jsonl.1"))) + \
+        sorted(glob.glob(os.path.join(directory, "flight.*.jsonl")))
+    for path in paths:
+        evs, err = read_file(path)
+        events.extend(evs)
+        if err:
+            errors.append(err)
+    events.sort(key=lambda e: (e.get("t", 0.0), e.get("seq", 0)))
+    return events, errors
+
+
+def runs(events: list[dict]) -> list[str]:
+    """Distinct run ids, oldest first (by first appearance in time)."""
+    seen: dict[str, float] = {}
+    for e in events:
+        run = e.get("run")
+        if run and run not in seen:
+            seen[run] = e.get("t", 0.0)
+    return sorted(seen, key=seen.get)
+
+
+def last_run_events(directory: str) -> tuple[list[dict], list[str]]:
+    """Events of the most recent run only (latest run id by first-seen
+    time), plus any file-level parse errors."""
+    events, errors = read_all(directory)
+    ids = runs(events)
+    if not ids:
+        return [], errors
+    last = ids[-1]
+    return [e for e in events if e.get("run") == last], errors
+
+
+def last_incident(directory: str) -> Optional[dict]:
+    """The most recent incident-class event of the most recent run, or
+    ``None``. Used by ``tools/doctor.py`` for a one-line health report."""
+    events, _ = last_run_events(directory)
+    incidents = [e for e in events if e.get("ev") in INCIDENT_EVENTS]
+    return incidents[-1] if incidents else None
+
+
+def default_dir() -> str:
+    """Repo-local fallback (``<repo>/.cache/flight``) for tools that
+    inspect the last local run without an explicit ``--flight-dir``."""
+    env = os.environ.get(ENV_FLIGHT_DIR)
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".cache", "flight")
+
+
+def describe(event: dict) -> str:
+    """One human line for an event — shared by postmortem and doctor."""
+    ev = event.get("ev", "?")
+    bits = []
+    for key in ("kind", "label", "attribution", "trigger", "child", "rc",
+                "step", "signum", "detail"):
+        if key in event and event[key] is not None:
+            bits.append(f"{key}={event[key]}")
+    stamp = time.strftime("%H:%M:%S", time.localtime(event.get("t", 0.0)))
+    host = event.get("host", "?")
+    attempt = event.get("attempt", 0)
+    suffix = f" ({', '.join(bits)})" if bits else ""
+    return f"{stamp} [a{attempt} h{host}] {ev}{suffix}"
+
+
+_RE_FLIGHT_FILE = re.compile(r"^flight\..+\.jsonl(\.1)?$")
+
+
+def is_flight_file(name: str) -> bool:
+    return bool(_RE_FLIGHT_FILE.match(name))

@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributeddeeplearning_tpu.observability import telemetry
+from distributeddeeplearning_tpu.observability import flight, telemetry
 from distributeddeeplearning_tpu.parallel.collectives import (
     _MB, AxisNames, BucketPlan, DEFAULT_BUCKET_MB, _numel, plan_buckets)
 
@@ -243,6 +243,12 @@ def _scatter_members(fulls, layout: Zero1Layout, axis_names: AxisNames,
     span_args = {"cat": "trace", "leaves": len(members)}
     if overlapped:
         span_args["overlapped"] = True
+    # Flight-record mirror of the trace span: this body runs once per
+    # COMPILE (trace time), so the record gets a one-shot collective-plan
+    # event per bucket, never a per-step fsync.
+    flight.get().record("collective", phase="reduce_scatter", scope=scope,
+                        bucket=b, leaves=len(members),
+                        overlapped=bool(overlapped))
     with tele.span(f"collective:{scope}", **span_args), \
             jax.named_scope(scope):
         common = (jnp.dtype(payload_dtype) if payload_dtype is not None
@@ -277,6 +283,8 @@ def _gather_members(chunks, layout: Zero1Layout, axis_names: AxisNames,
     n = layout.axis_size
     tele = telemetry.get()
     scope = f"{scope_prefix}/all_gather/bucket{b:02d}"
+    flight.get().record("collective", phase="all_gather", scope=scope,
+                        bucket=b, leaves=len(members))
     with tele.span(f"collective:{scope}", cat="trace",
                    leaves=len(members)), jax.named_scope(scope):
         common = jnp.result_type(*(layout.plan.dtypes[i] for i in members))

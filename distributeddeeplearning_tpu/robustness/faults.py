@@ -273,12 +273,15 @@ def make_injector(plan: FaultPlan, ckpt, checkpoint_dir: Optional[str]):
 def _fire_one(fault: Fault, step: int, ckpt, checkpoint_dir) -> None:
     import sys
 
-    from distributeddeeplearning_tpu.observability import telemetry
+    from distributeddeeplearning_tpu.observability import flight, telemetry
 
     # Instant event BEFORE firing: sigkill/crash never return, and the
     # surviving buffer is exported by the loop's finally (sigkill loses the
     # attempt's unexported events by design — that is what sigkill means).
     telemetry.get().instant(f"fault:{fault.kind}", step=step)
+    # The flight record is the one that SURVIVES sigkill: appended and
+    # fsync'd here, before the fault fires.
+    flight.get().record("fault", kind=fault.kind, step=step)
     if fault.kind == "corrupt_latest_ckpt":
         if ckpt is not None:
             ckpt.wait()  # damage a COMMITTED save, not an in-flight one

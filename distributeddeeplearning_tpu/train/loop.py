@@ -10,6 +10,7 @@ framework forks.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import threading
@@ -24,7 +25,10 @@ from distributeddeeplearning_tpu.config import (TrainConfig,
 from distributeddeeplearning_tpu import data as datalib
 from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
-from distributeddeeplearning_tpu.observability import health, telemetry
+from distributeddeeplearning_tpu.observability import anomaly as anomalylib
+from distributeddeeplearning_tpu.observability import flight as flightlib
+from distributeddeeplearning_tpu.observability import health, sidecars, telemetry
+from distributeddeeplearning_tpu.observability import metrics as metricslib
 from distributeddeeplearning_tpu.observability import straggler as stragglib
 from distributeddeeplearning_tpu.parallel import mesh as meshlib
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
@@ -357,6 +361,15 @@ def run(config: TrainConfig, *, total_steps: int,
         trace_dir=config.trace_dir, trace_steps=config.trace_steps,
         max_events=config.trace_max_events,
         process_index=jax.process_index())
+    # Flight recorder (observability/flight.py): the crash-surviving half
+    # of observability. config.flight_dir overrides the launcher-exported
+    # DDL_FLIGHT_DIR; with neither set the disabled singleton makes every
+    # record() a no-op. Configured before the first compile so the
+    # collective layers' one-shot plan events land in the record.
+    flight = flightlib.configure_from_env(
+        host=jax.process_index(),
+        directory=getattr(config, "flight_dir", None))
+    metricslib.configure(run_id=flight.run_id)
     # Persistent compile cache (perf/compile_cache.py): pointed at the
     # shared directory BEFORE any compile, and re-exported through the
     # environment so launcher children and restart attempts inherit it.
@@ -392,6 +405,13 @@ def run(config: TrainConfig, *, total_steps: int,
             warmup_steps=warmup_steps, eval_batches=eval_batches,
             return_state=return_state, restore_for_eval=restore_for_eval,
             t_origin=t_origin)
+    except BaseException as exc:
+        # Fsync'd BEFORE teardown: even if the finally below wedges, the
+        # flight record already explains how the run ended (SIGKILL skips
+        # this too, of course — but then the last fault/step event stands).
+        flight.record("abort", error=type(exc).__name__,
+                      detail=str(exc)[:300])
+        raise
     finally:
         if ckpt is not None:
             ckpt.close()  # releases the async-checkpointing executor
@@ -401,6 +421,7 @@ def run(config: TrainConfig, *, total_steps: int,
         if trace_file is not None:
             print(f"# telemetry trace written to {trace_file}",
                   file=sys.stderr, flush=True)
+        flight.close()
 
 
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
@@ -482,6 +503,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     # detection, the same clock telemetry.now_s() reads in this process, so
     # the first post-resume step closes the reconfiguration_time_s span.
     elastic_event = health.read_elastic_event()
+    flight = flightlib.get()
+    flight.record("run_start", step=start_step, total_steps=int(total_steps),
+                  degree=live_degree, model=config.model,
+                  resumed=bool(start_step))
+    if start_step:
+        flight.record("restore", step=start_step)
     # Source is created here — after restore — so a real (streaming) pipeline
     # starts at the resume step rather than replaying from zero. A run with
     # no steps left skips pipeline construction entirely.
@@ -601,6 +628,17 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     injector = faultslib.make_injector(fault_plan, ckpt,
                                        config.checkpoint_dir)
     bad_tracker = _BadStepTracker(config.bad_step_limit)
+    # Online anomaly detection (observability/anomaly.py) over the chief's
+    # log-cadence records: host-side medians only, so the cost is noise.
+    # Flags become flight-recorder events + trace instants, and non-finite
+    # signals feed bad_tracker so a diverged run still aborts when the
+    # compiled guard is off.
+    detector = (anomalylib.AnomalyDetector(
+        straggler_ratio=(config.straggler_threshold
+                         if config.straggler_threshold > 0 else 1.5))
+        if getattr(config, "anomaly_detection", True)
+        and jax.process_index() == 0 else None)
+    mreg = metricslib.get()
     metrics = {}
     timed_examples = 0
     profile = _Profiler(config)
@@ -635,6 +673,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             if preempted["signum"] is not None:
                 tele.instant("preempted", step=i,
                              signum=preempted["signum"])
+                flight.record("preempted", step=int(i),
+                              signum=preempted["signum"])
                 ckpt.maybe_save(i, state, force=True)
                 ckpt.wait()
                 raise SystemExit(
@@ -690,6 +730,15 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                                        - float(elastic_event["detect_t"]))
                     tele.gauge("reconfiguration_time_s",
                                round(reconfig_time_s, 3), step=int(i))
+                    # The outage span, closed: the launcher recorded the
+                    # re-formation *plan*; this records it *landed*.
+                    flight.record(
+                        "reconfiguration", step=int(i),
+                        trigger=elastic_event.get("trigger"),
+                        degree_before=elastic_event.get("degree_before"),
+                        degree_after=elastic_event.get("degree_after"),
+                        reconfiguration_time_s=round(reconfig_time_s, 3),
+                        resume_step=start_step)
                 if tele.enabled and getattr(train_step, "zero_stage", None):
                     # Backward/collective overlap gauge: fraction of the
                     # step's reduce-scatter spans issued INSIDE backward
@@ -740,10 +789,21 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     # used — one timestamp per log step, not two
                     # (utils/logging.py mirrors the record into telemetry
                     # gauges, closing the duplicated emit path).
-                    logger.log(int(i), metrics,
-                               examples_per_step=config.global_batch_size,
-                               now_s=t_log,
-                               lr=float(sched(i - 1)), **extra)
+                    log_rec = logger.log(
+                        int(i), metrics,
+                        examples_per_step=config.global_batch_size,
+                        now_s=t_log,
+                        lr=float(sched(i - 1)), **extra)
+                flight.record("step", step=int(i),
+                              loss=log_rec.get("loss"),
+                              examples_per_sec=log_rec.get(
+                                  "examples_per_sec"))
+                if jax.process_index() == 0:
+                    _observe_and_detect(log_rec, int(i), mreg, detector,
+                                        flight, tele, bad_tracker,
+                                        overlap_frac=overlap_frac,
+                                        data_wait_s=data_wait_acc,
+                                        interval_s=t_log - t_last_log)
                 if heartbeat is not None:
                     heartbeat.beat(int(i))
                 if tele.enabled:
@@ -756,12 +816,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 timed_examples += config.global_batch_size * n
             if ckpt is not None:
                 t_ck = telemetry.now_s() if tele.enabled else 0.0
-                if ckpt.maybe_save(i, state) and tele.enabled:
+                if ckpt.maybe_save(i, state):
                     # Recorded only when a save actually launched (async:
                     # the span is the launch + state-gather cost, not the
                     # full write).
-                    tele.record_span("checkpoint_save", t_ck,
-                                     telemetry.now_s(), step=int(i))
+                    if tele.enabled:
+                        tele.record_span("checkpoint_save", t_ck,
+                                         telemetry.now_s(), step=int(i))
+                    flight.record("save", step=int(i))
             if (eval_every_steps and i % eval_every_steps == 0
                     and i < total_steps):
                 t_eval = time.perf_counter()
@@ -798,7 +860,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         profile.finish()
     if ckpt is not None:
         if total_steps > start_step:
-            ckpt.maybe_save(total_steps, state, force=True)
+            if ckpt.maybe_save(total_steps, state, force=True):
+                flight.record("save", step=int(total_steps), final=True)
         ckpt.wait()
 
     summary: dict[str, Any] = {
@@ -832,6 +895,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if hbm:
         summary["memory"] = hbm
         if jax.process_index() == 0:
+            for k in ("resident_bytes_per_device", "peak_bytes_in_use"):
+                if k in hbm:
+                    mreg.observe(k, hbm[k], step=end_step)
             parts = []
             if "peak_bytes_in_use" in hbm:
                 parts.append(
@@ -883,6 +949,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             summary["eval_ppl"] = math.exp(min(final_val, 30.0))
     if return_state:
         summary["state"] = state
+    flight.record("run_end", step=end_step, bad_steps=bad_tracker.total)
+    if flight.enabled and jax.process_index() == 0:
+        # Final metrics export next to the flight record — the aggregate
+        # snapshot a post-mortem (or a textfile scraper) picks up.
+        mreg.write_prometheus(os.path.join(flight.directory, "metrics.prom"))
+        mreg.write_snapshot(
+            os.path.join(flight.directory, "metrics_snapshot.json"))
     return summary
 
 
@@ -920,19 +993,65 @@ class _BadStepTracker:
         while self._window:
             self._check(self._window.pop(0))
 
+    def note_anomaly(self) -> None:
+        """Anomaly-detector feed (observability/anomaly.py): a non-finite
+        loss/grad signal on the log cadence counts like a bad-step skip,
+        so a run pinned at NaN aborts through the SAME breaker even when
+        the compiled guard was never built into the step."""
+        self._bump()
+
     def _check(self, flag) -> None:
         if float(jax.device_get(flag)) > 0:
-            self.total += 1
-            self._consecutive += 1
-            if self._consecutive >= self.limit:
-                raise RuntimeError(
-                    f"aborting: {self._consecutive} consecutive non-finite "
-                    f"update steps (bad_step_limit={self.limit}) — the run "
-                    f"is diverging, not hitting stray bad batches; lower "
-                    f"the learning rate or inspect the data shards. "
-                    f"{self.total} update(s) were skipped in total.")
+            self._bump()
         else:
             self._consecutive = 0
+
+    def _bump(self) -> None:
+        self.total += 1
+        self._consecutive += 1
+        if self._consecutive >= self.limit:
+            raise RuntimeError(
+                f"aborting: {self._consecutive} consecutive non-finite "
+                f"update steps (bad_step_limit={self.limit}) — the run "
+                f"is diverging, not hitting stray bad batches; lower "
+                f"the learning rate or inspect the data shards. "
+                f"{self.total} update(s) were skipped in total.")
+
+
+def _observe_and_detect(log_rec, step, mreg, detector, flight, tele,
+                        bad_tracker, *, overlap_frac, data_wait_s,
+                        interval_s) -> None:
+    """Chief-side log-cadence fan-out: feed the metrics registry and the
+    anomaly detector from the record ``MetricLogger.log`` just built.
+
+    The straggler monitor's per-host fields ride inside ``log_rec`` (they
+    were passed to ``log`` as extras), so host skew needs no second
+    allgather here. The registry export refreshes every log step when a
+    flight dir exists — cheap (two small atomic writes) and it means a
+    killed run leaves a current snapshot, not just a final one.
+    """
+    mreg.observe_many(log_rec, step=step)
+    if overlap_frac is not None:
+        mreg.observe("backward_collective_overlap", overlap_frac, step=step)
+    skew = None
+    if log_rec.get("host_step_time_mean"):
+        skew = (log_rec.get("host_step_time_max", 0.0)
+                / log_rec["host_step_time_mean"])
+        mreg.observe("host_step_time_skew", skew, step=step)
+    if detector is not None:
+        wait_frac = (data_wait_s / interval_s) if interval_s > 1e-9 else None
+        anomalies = detector.update(
+            step, loss=log_rec.get("loss"),
+            grad_norm=log_rec.get("grad_norm"),
+            examples_per_sec=log_rec.get("examples_per_sec"),
+            data_wait_frac=wait_frac, straggler_ratio=skew,
+            bad_step=log_rec.get("bad_step"))
+        anomalylib.report(anomalies, flight_rec=flight, tele=tele,
+                          bad_tracker=bad_tracker)
+    if flight.enabled:
+        mreg.write_prometheus(os.path.join(flight.directory, "metrics.prom"))
+        mreg.write_snapshot(
+            os.path.join(flight.directory, "metrics_snapshot.json"))
 
 
 def _record_hbm_gauges(tele, step: int) -> None:
@@ -950,10 +1069,9 @@ def _record_hbm_gauges(tele, step: int) -> None:
 
 
 def _sharding_sidecar_path() -> str:
-    import os
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return os.path.join(repo, ".cache", "last_run_sharding.json")
+    # Indirection kept monkeypatchable (tests redirect it off-repo); the
+    # write itself goes through the shared helper (observability/sidecars).
+    return sidecars.path_for("last_run_sharding")
 
 
 def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
@@ -961,35 +1079,21 @@ def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
     tools/doctor.py looks (best-effort, like the compile-cache stats)."""
     if jax.process_index() != 0:
         return
-    try:
-        import json
-        import os
-        path = _sharding_sidecar_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        info = {
-            "optimizer_sharding": config.optimizer_sharding,
-            "overlap_collectives": bool(
-                getattr(config, "overlap_collectives", True)),
-            "overlap": bool(getattr(train_step, "overlap", False)),
-            "overlap_fraction": overlap_frac,
-            "opt_state_offload": bool(
-                getattr(config, "opt_state_offload", False)),
-            "dp": config.parallel.data * config.parallel.fsdp,
-            "model": config.model,
-        }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(info, fh, indent=2, sort_keys=True)
-        os.replace(tmp, path)
-    except Exception:
-        pass
+    sidecars.write(_sharding_sidecar_path(), {
+        "optimizer_sharding": config.optimizer_sharding,
+        "overlap_collectives": bool(
+            getattr(config, "overlap_collectives", True)),
+        "overlap": bool(getattr(train_step, "overlap", False)),
+        "overlap_fraction": overlap_frac,
+        "opt_state_offload": bool(
+            getattr(config, "opt_state_offload", False)),
+        "dp": config.parallel.data * config.parallel.fsdp,
+        "model": config.model,
+    })
 
 
 def _elastic_sidecar_path() -> str:
-    import os
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return os.path.join(repo, ".cache", "last_elastic_event.json")
+    return sidecars.path_for("last_elastic_event")
 
 
 def _write_elastic_sidecar(event, reconfig_time_s, resume_step) -> None:
@@ -997,26 +1101,15 @@ def _write_elastic_sidecar(event, reconfig_time_s, resume_step) -> None:
     tools/doctor.py looks (best-effort, like the sharding sidecar)."""
     if jax.process_index() != 0:
         return
-    try:
-        import json
-        import os
-        path = _elastic_sidecar_path()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        info = {
-            "trigger": event.get("trigger"),
-            "degree_before": event.get("degree_before"),
-            "degree_after": event.get("degree_after"),
-            "reconfiguration_time_s": (round(reconfig_time_s, 3)
-                                       if reconfig_time_s is not None
-                                       else None),
-            "resume_step": int(resume_step),
-        }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(info, fh, indent=2, sort_keys=True)
-        os.replace(tmp, path)
-    except Exception:
-        pass
+    sidecars.write(_elastic_sidecar_path(), {
+        "trigger": event.get("trigger"),
+        "degree_before": event.get("degree_before"),
+        "degree_after": event.get("degree_after"),
+        "reconfiguration_time_s": (round(reconfig_time_s, 3)
+                                   if reconfig_time_s is not None
+                                   else None),
+        "resume_step": int(resume_step),
+    })
 
 
 def _device_memory_stats(state=None, train_step=None) -> Optional[dict]:

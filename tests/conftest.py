@@ -53,6 +53,9 @@ def pytest_runtest_logreport(report):
         # elastic likewise: tools/marker_audit.py --expect-elastic verifies
         # a fast cross-degree resume test survived in tier-1.
         "elastic": "elastic" in report.keywords,
+        # flight likewise: tools/marker_audit.py --expect-flight verifies
+        # the crash-surviving flight record is exercised in tier-1.
+        "flight": "flight" in report.keywords,
     })
 
 

@@ -1,0 +1,131 @@
+"""Unit tests for observability/health.py: the heartbeat staleness clock
+and the failure-attribution evidence it feeds (launch.attribute_failure).
+
+These are the load-bearing primitives under the hang watchdog, the
+elastic membership controller, and the flight record's attribution
+events — tested here directly, without spawning a launcher, by steering
+file mtimes with os.utime and injecting ``now``.
+"""
+
+import json
+import os
+
+from distributeddeeplearning_tpu import launch
+from distributeddeeplearning_tpu.observability import health
+
+
+# --- heartbeat writer -------------------------------------------------------
+
+def test_heartbeat_path_layout(tmp_path):
+    assert health.heartbeat_path(str(tmp_path), 3) == str(
+        tmp_path / "heartbeat.3")
+
+
+def test_writer_beats_are_atomic_json_breadcrumbs(tmp_path):
+    w = health.HeartbeatWriter(str(tmp_path), process_id=2)
+    w.beat(41)
+    with open(w.path) as fh:
+        crumb = json.load(fh)
+    assert crumb["step"] == 41
+    assert crumb["pid"] == os.getpid()
+    assert crumb["time"] > 0
+    # no tmp litter: the write is tmp + os.replace
+    assert sorted(os.listdir(tmp_path)) == ["heartbeat.2"]
+    w.beat(42)
+    with open(w.path) as fh:
+        assert json.load(fh)["step"] == 42
+
+
+def test_writer_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(health.ENV_HEARTBEAT_DIR, raising=False)
+    assert health.HeartbeatWriter.from_env() is None
+    monkeypatch.setenv(health.ENV_HEARTBEAT_DIR, str(tmp_path))
+    monkeypatch.setenv("DDL_PROCESS_ID", "5")
+    w = health.HeartbeatWriter.from_env()
+    assert w is not None and w.process_id == 5
+    assert w.path == health.heartbeat_path(str(tmp_path), 5)
+
+
+def test_writer_survives_unwritable_directory(tmp_path):
+    w = health.HeartbeatWriter(str(tmp_path), process_id=0)
+    os.chmod(tmp_path, 0o500)
+    try:
+        w.beat(1)  # must not raise: a broken disk never kills a step
+    finally:
+        os.chmod(tmp_path, 0o700)
+
+
+# --- staleness clock --------------------------------------------------------
+
+def test_check_stale_reports_only_aged_heartbeats(tmp_path):
+    d = str(tmp_path)
+    for pid in (0, 1):
+        health.HeartbeatWriter(d, pid).beat(10)
+    now = os.stat(health.heartbeat_path(d, 0)).st_mtime
+    # age child 1 by 30 s against the injected clock
+    os.utime(health.heartbeat_path(d, 1), (now - 30, now - 30))
+    stale = health.check_stale(d, num_processes=2, timeout_s=20.0, now=now)
+    assert [pid for pid, _ in stale] == [1]
+    assert stale[0][1] >= 30.0
+    # tighten the timeout below both ages: both report, fresh one first
+    stale = health.check_stale(d, num_processes=2, timeout_s=-1.0, now=now)
+    assert [pid for pid, _ in stale] == [0, 1]
+
+
+def test_check_stale_never_judges_a_child_that_never_beat(tmp_path):
+    d = str(tmp_path)
+    health.HeartbeatWriter(d, 0).beat(1)
+    now = os.stat(health.heartbeat_path(d, 0)).st_mtime + 1e6
+    # child 1 and 2 have no file: startup/compile grace needs no special
+    # case because the watchdog only arms per child on its first beat.
+    stale = health.check_stale(d, num_processes=3, timeout_s=10.0, now=now)
+    assert [pid for pid, _ in stale] == [0]
+
+
+# --- rejoin marker + elastic event ------------------------------------------
+
+def test_rejoin_marker_consumed_exactly_once(tmp_path):
+    d = str(tmp_path)
+    assert not health.consume_rejoin(d)
+    health.announce_rejoin(d)
+    assert os.path.exists(health.rejoin_path(d))
+    assert health.consume_rejoin(d)
+    assert not health.consume_rejoin(d)  # one announcement, one re-formation
+
+
+def test_read_elastic_event(monkeypatch):
+    monkeypatch.delenv(health.ENV_ELASTIC_EVENT, raising=False)
+    assert health.read_elastic_event() is None
+    monkeypatch.setenv(health.ENV_ELASTIC_EVENT, "{not json")
+    assert health.read_elastic_event() is None
+    monkeypatch.setenv(health.ENV_ELASTIC_EVENT, "[1, 2]")
+    assert health.read_elastic_event() is None  # must be an object
+    event = {"trigger": "host_lost", "degree_before": 4, "degree_after": 2,
+             "detect_t": 12.5}
+    monkeypatch.setenv(health.ENV_ELASTIC_EVENT, json.dumps(event))
+    assert health.read_elastic_event() == event
+
+
+# --- failure attribution from the evidence ----------------------------------
+
+def test_attribution_hung_wins_over_everything(tmp_path):
+    assert launch.attribute_failure(str(tmp_path), 0, hung=True,
+                                    ever_beat=True) == "hung"
+
+
+def test_attribution_host_lost_needs_beat_then_vanished_file(tmp_path):
+    d = str(tmp_path)
+    w = health.HeartbeatWriter(d, 0)
+    w.beat(7)
+    # heartbeat intact -> transient crash, host is fine
+    assert launch.attribute_failure(d, 0, ever_beat=True) == "crash"
+    os.remove(w.path)
+    # beat once, file gone with the process -> the host took its
+    # filesystem presence with it
+    assert launch.attribute_failure(d, 0, ever_beat=True) == "host_lost"
+    # never armed: a missing file is startup death, not host loss
+    assert launch.attribute_failure(d, 0, ever_beat=False) == "crash"
+
+
+def test_attribution_without_heartbeat_dir_is_crash():
+    assert launch.attribute_failure(None, 0, ever_beat=True) == "crash"

@@ -321,4 +321,28 @@ if [ "${DDL_TELEMETRY:-0}" = "1" ]; then
   python tools/summarize_trace.py "$RES"/trace/trace.p*.json \
     >> "$RES/log.txt" 2>&1 || true
 fi
+
+# --- Gated flight-record rehearsal (ask with DDL_FLIGHT=1) ----------------
+# CPU-only, OFF by default (same reasoning as the chaos step): a short
+# launch.py run with a sigkill injected mid-attempt, recorded into a
+# flight dir under $RES, then tools/postmortem.py --json over it. The
+# artifact pair (flight dir + postmortem JSON) proves end to end that a
+# hard kill leaves a complete, parseable record with an attributed
+# incident chain — the thing docs/observability.md promises on-call.
+if [ "${DDL_FLIGHT:-0}" = "1" ]; then
+  check_stop flight
+  rm -rf "$RES/flight" && mkdir -p "$RES/flight"
+  timeout 600 env JAX_PLATFORMS=cpu \
+    python launch.py --num-processes 1 --max-restarts 2 --backoff 0.2 \
+    --heartbeat-timeout 120 --flight-dir "$RES/flight" -- \
+    python train.py --backend cpu --model resnet18_thin --image-size 32 \
+    --batch-size 8 --dp 1 --synthetic --dtype float32 --steps 6 \
+    --checkpoint-dir "$RES/flight_ckpt" --checkpoint-every 2 \
+    --log-every 1000000 --fault-plan "sigkill@4" >> "$RES/log.txt" 2>&1
+  note flight_chaos
+  timeout 120 env JAX_PLATFORMS=cpu python tools/postmortem.py \
+    "$RES/flight" --checkpoint-dir "$RES/flight_ckpt" --json \
+    > "$RES/postmortem.json" 2>> "$RES/log.txt"
+  note flight_postmortem
+fi
 echo "[$(stamp)] window done" >> "$RES/log.txt"

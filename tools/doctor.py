@@ -183,13 +183,10 @@ def check_caches(prune_days: float = 0.0) -> None:
                   "compile_cache_entries": entries,
                   "policy_error": str(e)[:200]}
     last = None
-    try:
-        with open(os.path.join(REPO, ".cache", "last_bench.json")) as f:
-            table = json.load(f)
-        key = "resnet50_imagenet_images_per_sec_per_chip"
-        last = table.get(key) if isinstance(table, dict) else None
-    except (OSError, ValueError):
-        pass
+    from distributeddeeplearning_tpu.observability import sidecars
+    table = sidecars.read("last_bench")
+    if isinstance(table, dict):
+        last = table.get("resnet50_imagenet_images_per_sec_per_chip")
     last_fields = None
     if isinstance(last, dict):
         last_fields = {k: last.get(k) for k in ("value", "measured_at")}
@@ -235,20 +232,16 @@ def check_sharding() -> None:
     sharding did that run actually use?" is answerable from doctor output
     without re-reading run logs. ok=True always: an absent sidecar just
     means no sharded run has happened yet."""
-    path = os.path.join(REPO, ".cache", "last_run_sharding.json")
-    try:
-        with open(path) as fh:
-            side = json.load(fh)
-        if not isinstance(side, dict):
-            raise ValueError("sidecar is not a JSON object")
+    from distributeddeeplearning_tpu.observability import sidecars
+    side = sidecars.read("last_run_sharding")
+    if side is not None:
         emit("optimizer_sharding", ok=True,
              **{k: side.get(k) for k in (
                  "optimizer_sharding", "overlap_collectives", "overlap",
                  "overlap_fraction", "opt_state_offload", "dp", "model")})
-    except (OSError, ValueError) as e:
+    else:
         emit("optimizer_sharding", ok=True, last_run=None,
-             note=f"no sharding sidecar ({e.__class__.__name__}); "
-                  f"written by the first train run")
+             note="no sharding sidecar; written by the first train run")
 
 
 def check_elastic() -> None:
@@ -259,20 +252,42 @@ def check_elastic() -> None:
     seconds, and the resume step — so "what did the last re-formation
     cost?" is answerable from doctor output. ok=True always: an absent
     sidecar just means no elastic re-formation has happened yet."""
-    path = os.path.join(REPO, ".cache", "last_elastic_event.json")
-    try:
-        with open(path) as fh:
-            side = json.load(fh)
-        if not isinstance(side, dict):
-            raise ValueError("sidecar is not a JSON object")
+    from distributeddeeplearning_tpu.observability import sidecars
+    side = sidecars.read("last_elastic_event")
+    if side is not None:
         emit("elastic", ok=True,
              **{k: side.get(k) for k in (
                  "trigger", "degree_before", "degree_after",
                  "reconfiguration_time_s", "resume_step")})
-    except (OSError, ValueError) as e:
+    else:
         emit("elastic", ok=True, last_event=None,
-             note=f"no elastic sidecar ({e.__class__.__name__}); written "
-                  f"when a launch.py --elastic run re-forms")
+             note="no elastic sidecar; written when a launch.py --elastic "
+                  "run re-forms")
+
+
+def check_flight() -> None:
+    """Last incident from the flight record (observability/flight.py):
+    the most recent fault / anomaly / attributed child exit / stale
+    heartbeat on record, in one human line — so "what killed the last
+    run?" is answerable from doctor output before anyone opens
+    tools/postmortem.py. ok=True always: an absent or incident-free
+    record is a healthy state, not a failure."""
+    try:
+        from distributeddeeplearning_tpu.observability import flight
+        directory = flight.default_dir()
+        incident = flight.last_incident(directory)
+        if incident is None:
+            emit("flight_record", ok=True, last_incident=None,
+                 flight_dir=directory,
+                 note="no incident on record; record with --flight-dir "
+                      "(train.py / launch.py)")
+        else:
+            emit("flight_record", ok=True, flight_dir=directory,
+                 last_incident=flight.describe(incident),
+                 run=incident.get("run"), kind=incident.get("ev"),
+                 step=incident.get("step"))
+    except Exception as e:
+        emit("flight_record", ok=True, error=str(e)[:200])
 
 
 def main(argv=None) -> int:
@@ -292,6 +307,7 @@ def main(argv=None) -> int:
     check_perf_gate()
     check_sharding()
     check_elastic()
+    check_flight()
     return 0
 
 

@@ -107,18 +107,44 @@ def audit_elastic(records) -> list[str]:
     return problems
 
 
+def audit_flight(records) -> list[str]:
+    """Problems with flight-recorder / post-mortem coverage in this run.
+
+    The crash-surviving flight record (tests marked ``flight``) has the
+    same silent-disarm failure modes: the marked tests vanish from the
+    selection, or every one of them is also marked ``slow`` and tier-1's
+    ``-m 'not slow'`` stops proving that a SIGKILL leaves a complete,
+    parseable record with an attributable post-mortem."""
+    problems = []
+    flight = [r for r in records if r.get("flight")]
+    if not flight:
+        problems.append(
+            "no flight-marked test ran — the crash-surviving flight "
+            "record is untested in this run (tests/test_flight.py "
+            "missing, renamed, or deselected?)")
+    elif all(r.get("slow") for r in flight):
+        problems.append(
+            "every flight-marked test is also marked slow — tier-1 runs "
+            "-m 'not slow', so the flight record / post-mortem path is "
+            "silently untested in tier-1 (keep a fast flight variant "
+            "unmarked)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
               f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
-              f"[--expect-elastic]")
+              f"[--expect-elastic] [--expect-flight]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
     expect_elastic = "--expect-elastic" in argv
+    expect_flight = "--expect-flight" in argv
     argv = [a for a in argv
-            if a not in ("--expect-perf-gate", "--expect-elastic")]
+            if a not in ("--expect-perf-gate", "--expect-elastic",
+                         "--expect-flight")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -140,6 +166,9 @@ def main(argv=None) -> int:
     # presence checks, meaningless on partial runs).
     if expect_elastic:
         gate_problems += audit_elastic(records)
+    # Flight-record coverage likewise (both problems are presence checks).
+    if expect_flight:
+        gate_problems += audit_flight(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""One-command incident report from a run's crash-surviving evidence.
+
+    python tools/postmortem.py [FLIGHT_DIR] [--trace-dir DIR]
+                               [--heartbeat-dir DIR] [--checkpoint-dir DIR]
+                               [--run RUN_ID] [--json] [--out PATH]
+
+Assembles everything a dead run left behind into a single report:
+
+  * the flight record (observability/flight.py) — merged across hosts,
+    attempts and the launcher into one timeline, torn tails salvaged;
+  * an **attributed incident chain** — the causal story, e.g.
+    "host 2 lost at step 412 → re-formed 4→2 in 15.0 s → resumed from
+    step 400" — derived from fault / attribution / re-formation /
+    restore events;
+  * the metrics snapshot the registry exported next to the record;
+  * heartbeat files (who was still beating, and at what step);
+  * the telemetry trace summary (tools/summarize_trace.py) when a
+    --trace-dir is given;
+  * the elastic sidecar and any quarantined (``corrupt.N``) checkpoints.
+
+FLIGHT_DIR defaults to ``$DDL_FLIGHT_DIR``, else the repo-local
+``.cache/flight``. The newest run in the record is reported; ``--run``
+selects an older one. Pure stdlib + jax-free observability modules —
+safe to run anywhere, including a host that cannot initialize a backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.observability import flight  # noqa: E402
+from distributeddeeplearning_tpu.observability import health  # noqa: E402
+from distributeddeeplearning_tpu.observability import sidecars  # noqa: E402
+
+# Event kinds that appear verbatim in the timeline. "step" and
+# "collective" are dense bookkeeping — they are summarized, not listed.
+_TIMELINE_SKIP = ("step", "collective")
+
+
+def incident_chain(events: list[dict]) -> list[str]:
+    """The causal story of the run's LAST failure, as narrative fragments.
+
+    Walks the merged timeline for the final trigger (fault injection,
+    stale heartbeat, preemption, anomaly abort, or an attributed child
+    exit), then follows it forward through restart / re-formation /
+    restore to the step training resumed from.
+    """
+    # Prefer the last ROOT cause (a fault firing, a stale heartbeat, a
+    # preemption, an abort) over the child_exit that merely reports its
+    # consequence — the exit still contributes the attribution verdict.
+    roots = [e for e in events if e.get("ev") in
+             ("fault", "heartbeat_stale", "preempted", "abort")]
+    exits = [e for e in events
+             if e.get("ev") == "child_exit" and e.get("rc")]
+    trig = roots[-1] if roots else (exits[-1] if exits else None)
+    if trig is None:
+        return []
+    t0 = trig.get("t", 0.0)
+    chain: list[str] = []
+    ev = trig.get("ev")
+    if ev == "fault":
+        chain.append(f"host {trig.get('host')} {trig.get('kind')} "
+                     f"at step {trig.get('step')}")
+    elif ev == "heartbeat_stale":
+        chain.append(f"child {trig.get('child')} heartbeat stale "
+                     f"({trig.get('age_s')}s) — presumed hung")
+    elif ev == "preempted":
+        chain.append(f"host {trig.get('host')} preempted (signal "
+                     f"{trig.get('signum')}) at step {trig.get('step')}")
+    elif ev == "abort":
+        chain.append(f"host {trig.get('host')} aborted: "
+                     f"{trig.get('error')} ({trig.get('detail')})")
+    else:
+        chain.append(f"child {trig.get('child')} exited rc={trig.get('rc')}")
+    # The verdict usually follows the trigger within the same poll.
+    for e in events:
+        if (e.get("ev") == "child_exit" and e.get("t", 0.0) >= t0
+                and e.get("attribution")):
+            chain.append(f"attributed as {e['attribution']} "
+                         f"(child {e.get('child')}, rc={e.get('rc')})")
+            break
+    after = [e for e in events if e.get("t", 0.0) >= t0]
+    for e in after:
+        if e.get("ev") == "reconfiguration":
+            chain.append(f"re-formed {e.get('degree_before')}→"
+                         f"{e.get('degree_after')} in "
+                         f"{e.get('reconfiguration_time_s')} s")
+            break
+        if e.get("ev") == "reconfiguration_planned":
+            chain.append(f"re-formation planned {e.get('degree_before')}→"
+                         f"{e.get('degree_after')} "
+                         f"({e.get('trigger')})")
+    for e in after:
+        if e.get("ev") == "restart":
+            chain.append(f"restart {e.get('restart')} "
+                         f"(backoff {e.get('backoff_s')} s)")
+            break
+    for e in after:
+        if e.get("ev") == "restore":
+            chain.append(f"resumed from step {e.get('step')}")
+            break
+    else:
+        for e in after:
+            if e.get("ev") == "run_start" and e is not trig:
+                chain.append(f"relaunched at step {e.get('step')}")
+                break
+    for e in after:
+        if e.get("ev") == "run_end":
+            chain.append(f"run completed at step {e.get('step')}")
+        elif e.get("ev") == "giving_up":
+            chain.append(f"gave up after {e.get('restarts')} restart(s) "
+                         f"(rc={e.get('rc')})")
+    return chain
+
+
+def _quarantined(checkpoint_dir: str) -> list[str]:
+    try:
+        return sorted(d for d in os.listdir(checkpoint_dir)
+                      if d.startswith("corrupt."))
+    except OSError:
+        return []
+
+
+def _heartbeats(heartbeat_dir: str) -> list[dict]:
+    out = []
+    try:
+        names = sorted(os.listdir(heartbeat_dir))
+    except OSError:
+        return out
+    now = time.time()
+    for name in names:
+        if not name.startswith("heartbeat."):
+            continue
+        path = os.path.join(heartbeat_dir, name)
+        entry: dict = {"file": name}
+        try:
+            entry["age_s"] = round(now - os.path.getmtime(path), 1)
+            with open(path, encoding="utf-8") as fh:
+                entry.update(json.load(fh))
+        except (OSError, ValueError):
+            entry["error"] = "unreadable"
+        out.append(entry)
+    return out
+
+
+def build_report(flight_dir: str, *, trace_dir: str | None = None,
+                 heartbeat_dir: str | None = None,
+                 checkpoint_dir: str | None = None,
+                 run: str | None = None) -> dict:
+    all_events, errors = flight.read_all(flight_dir)
+    run_ids = flight.runs(all_events)
+    if run is None:
+        run = run_ids[-1] if run_ids else None
+    events = [e for e in all_events if e.get("run") == run]
+    attempts = sorted({e.get("attempt", 0) for e in events})
+    hosts = sorted({str(e.get("host")) for e in events})
+    steps = [e for e in events if e.get("ev") == "step"]
+    collectives = [e for e in events if e.get("ev") == "collective"]
+    timeline = [e for e in events if e.get("ev") not in _TIMELINE_SKIP]
+    # One step milestone per attempt keeps progress visible without the
+    # dense per-cadence records drowning the story.
+    for a in attempts:
+        a_steps = [e for e in steps if e.get("attempt", 0) == a]
+        if a_steps:
+            timeline.append(a_steps[-1])
+    timeline.sort(key=lambda e: (e.get("t", 0.0), e.get("seq", 0)))
+    report: dict = {
+        "flight_dir": flight_dir,
+        "run": run,
+        "runs_on_record": run_ids,
+        "parse_errors": errors,
+        "complete": not errors,
+        "events": len(events),
+        "hosts": hosts,
+        "attempts": attempts,
+        "last_step": max((e.get("step") or 0 for e in steps), default=None),
+        "timeline": [{k: v for k, v in e.items() if k != "_file"}
+                     for e in timeline],
+        "incident": incident_chain(events),
+        "anomalies": [e for e in events if e.get("ev") == "anomaly"],
+        "collective_plan_events": len(collectives),
+    }
+    snap = sidecars.read(os.path.join(flight_dir, "metrics_snapshot.json"))
+    if snap:
+        report["metrics_snapshot"] = snap
+    elastic = sidecars.read("last_elastic_event")
+    if elastic:
+        # The sidecar is global (.cache) state — fold it in only when it
+        # was written during the run being reported, else it narrates a
+        # re-formation from some unrelated earlier job.
+        t0 = min((e.get("t") for e in events if e.get("t")), default=None)
+        stamp = elastic.get("written_at", elastic.get("updated_at"))
+        if t0 is None or (isinstance(stamp, (int, float)) and stamp >= t0):
+            report["elastic_sidecar"] = elastic
+    if heartbeat_dir is None:
+        heartbeat_dir = os.environ.get(health.ENV_HEARTBEAT_DIR)
+    if heartbeat_dir:
+        report["heartbeats"] = _heartbeats(heartbeat_dir)
+    if checkpoint_dir:
+        report["quarantined_checkpoints"] = _quarantined(checkpoint_dir)
+    if trace_dir:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import summarize_trace as stl
+        paths = stl.expand_traces([trace_dir])
+        if paths:
+            s = stl.summarize(paths)
+            report["trace"] = {
+                "files": len(paths), "events": s["events"],
+                "load_errors": s["load_errors"],
+                "phases": {k: v["total_ms"]
+                           for k, v in s["phases"].items()},
+                "instants": [i["name"] for i in s["instants"]],
+            }
+    return report
+
+
+def print_report(r: dict) -> None:
+    print(f"incident report — run {r['run'] or '(none on record)'}")
+    print(f"  flight record: {r['events']} events from "
+          f"{len(r['hosts'])} writer(s) ({', '.join(r['hosts'])}), "
+          f"attempts {r['attempts']}, "
+          f"{'complete' if r['complete'] else 'DAMAGED'}")
+    for err in r["parse_errors"]:
+        print(f"  WARNING: {err}")
+    if r.get("last_step") is not None:
+        print(f"  last recorded step: {r['last_step']}")
+    if r["incident"]:
+        print("\nattributed incident:")
+        print("  " + " → ".join(r["incident"]))
+    else:
+        print("\nno incident on record (clean run)")
+    print("\ntimeline:")
+    for e in r["timeline"]:
+        print(f"  {flight.describe(e)}")
+    if r.get("anomalies"):
+        print("\nanomalies:")
+        for a in r["anomalies"]:
+            print(f"  step {a.get('step')}: {a.get('kind')} — "
+                  f"{a.get('detail')}")
+    snap = r.get("metrics_snapshot")
+    if snap and snap.get("metrics"):
+        print("\nmetrics at last export:")
+        for name in sorted(snap["metrics"]):
+            m = snap["metrics"][name]
+            print(f"  {name:<32} last={m.get('last'):<12g} "
+                  f"min={m.get('min'):<12g} max={m.get('max'):<12g}")
+    if r.get("heartbeats"):
+        print("\nheartbeats:")
+        for hb in r["heartbeats"]:
+            print(f"  {hb.get('file')}: step {hb.get('step')} "
+                  f"(age {hb.get('age_s')}s)")
+    if r.get("elastic_sidecar"):
+        e = r["elastic_sidecar"]
+        print(f"\nelastic sidecar: {e.get('trigger')} "
+              f"{e.get('degree_before')}→{e.get('degree_after')} "
+              f"({e.get('reconfiguration_time_s')} s), "
+              f"resumed from step {e.get('resume_step')}")
+    if r.get("quarantined_checkpoints"):
+        print("\nquarantined checkpoints: "
+              + ", ".join(r["quarantined_checkpoints"]))
+    if r.get("trace"):
+        t = r["trace"]
+        print(f"\ntrace: {t['files']} file(s), {t['events']} events; "
+              f"top phases: "
+              + ", ".join(f"{k}={v:.1f}ms" for k, v in sorted(
+                  t["phases"].items(), key=lambda kv: -kv[1])[:5]))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("flight_dir", nargs="?", default=None,
+                   help="flight-record directory (default: $DDL_FLIGHT_DIR, "
+                        "else <repo>/.cache/flight)")
+    p.add_argument("--trace-dir", default=None,
+                   help="fold a telemetry trace summary into the report")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="heartbeat directory (default: $DDL_HEARTBEAT_DIR)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="list quarantined (corrupt.N) checkpoints from here")
+    p.add_argument("--run", default=None,
+                   help="report a specific run id (default: the newest)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one JSON object")
+    p.add_argument("--out", default=None,
+                   help="also write the report (JSON) to this path")
+    args = p.parse_args(argv)
+    flight_dir = args.flight_dir or flight.default_dir()
+    if not os.path.isdir(flight_dir):
+        print(f"no flight record at {flight_dir} — run with --flight-dir "
+              f"(train.py / launch.py) to record one", file=sys.stderr)
+        return 1
+    report = build_report(flight_dir, trace_dir=args.trace_dir,
+                          heartbeat_dir=args.heartbeat_dir,
+                          checkpoint_dir=args.checkpoint_dir, run=args.run)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True, default=str)
+    if args.json:
+        print(json.dumps(report, sort_keys=True, default=str))
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -169,6 +169,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--trace-steps", default=None, metavar="A,B",
                    help="restrict step-tagged telemetry events to steps "
                         "[A,B) (default: the whole run)")
+    p.add_argument("--flight-dir", default=None,
+                   help="flight recorder: crash-surviving fsync'd JSONL "
+                        "event log (steps, saves/restores, faults, "
+                        "anomalies, re-formations) written here, one file "
+                        "per host, plus Prometheus-text + JSON metric "
+                        "exports; read with tools/postmortem.py (default: "
+                        "$DDL_FLIGHT_DIR from launch.py --flight-dir, else "
+                        "off)")
+    p.add_argument("--no-anomaly-detection", action="store_true",
+                   help="disable the online anomaly detector (loss spikes, "
+                        "grad-norm drift, throughput collapse, straggler "
+                        "trending on the log cadence)")
     p.add_argument("--straggler-threshold", type=float, default=None,
                    help="multi-host: warn when a host's log-cadence step "
                         "time exceeds this multiple of the cross-host mean "
@@ -289,6 +301,10 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(profile_dir=args.profile_dir)
     if args.trace_dir:
         cfg = cfg.replace(trace_dir=args.trace_dir)
+    if args.flight_dir:
+        cfg = cfg.replace(flight_dir=args.flight_dir)
+    if args.no_anomaly_detection:
+        cfg = cfg.replace(anomaly_detection=False)
     if args.trace_steps:
         try:
             lo, hi = (int(x) for x in args.trace_steps.split(","))

@@ -662,8 +662,11 @@ def _child(args) -> int:
     return 0
 
 
-LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              ".cache", "last_bench.json")
+# Routed through observability/sidecars.py (atomic publish + envelope);
+# sidecars is pure stdlib, so the jax-free parent can import it.
+from distributeddeeplearning_tpu.observability import sidecars  # noqa: E402
+
+LAST_GOOD_PATH = sidecars.path_for("last_bench")
 
 
 def _record_last_good(line: str) -> None:
@@ -671,19 +674,15 @@ def _record_last_good(line: str) -> None:
     keyed by metric so a suite run can't evict the headline's entry."""
     try:
         rec = json.loads(line)
-        try:
-            with open(LAST_GOOD_PATH) as f:
-                table = json.load(f)
-            if not isinstance(table, dict) or "metric" in table:
-                table = {}  # legacy single-record layout: start over
-        except (OSError, ValueError):
-            table = {}
-        table[rec["metric"]] = rec
-        os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
-        with open(LAST_GOOD_PATH, "w") as f:
-            json.dump(table, f)
-    except (OSError, ValueError):
-        pass  # cache is evidence, not correctness
+        metric = rec["metric"]
+    except (ValueError, TypeError, KeyError):
+        return  # cache is evidence, not correctness
+    side = sidecars.read(LAST_GOOD_PATH) or {}
+    table = side.get("metrics")
+    if not isinstance(table, dict):
+        table = {}  # legacy flat/single-record layouts: start over
+    table[metric] = rec
+    sidecars.write(LAST_GOOD_PATH, {"metrics": table})
 
 
 def _emit_error(args, msg: str, attempts: list | None = None) -> None:
@@ -708,8 +707,8 @@ def _emit_error(args, msg: str, attempts: list | None = None) -> None:
     max_age = getattr(args, "max_stale_age",
                       perf_report.DEFAULT_MAX_STALE_AGE_S)
     try:
-        with open(LAST_GOOD_PATH) as f:
-            table = json.load(f)
+        side = sidecars.read(LAST_GOOD_PATH) or {}
+        table = side.get("metrics")
         prior = table.get(metric) if isinstance(table, dict) else None
         if isinstance(prior, dict) and prior.get("metric") == metric:
             age = perf_report.measurement_age_s(prior.get("measured_at"))
@@ -765,14 +764,18 @@ def _run_chaos(args) -> int:
     import shutil
     import tempfile
 
+    from distributeddeeplearning_tpu.observability import perf_report
+
     base = os.path.dirname(os.path.abspath(__file__))
     steps, fail_at, every = args.chaos_steps, args.chaos_fail_at, 2
     metric = "chaos_recovery_overhead"
     if not 0 < fail_at < steps:
-        print(json.dumps({
+        # with_backend=False here and below: the chaos harness is the
+        # PARENT — it spawns launch.py children and never initializes jax.
+        print(json.dumps(perf_report.annotate({
             "metric": metric, "value": None, "unit": "s per fault",
-            "error": f"--chaos-fail-at must be in (0, {steps})"}),
-            flush=True)
+            "error": f"--chaos-fail-at must be in (0, {steps})"},
+            provenance="error", with_backend=False)), flush=True)
         return 0
     root = tempfile.mkdtemp(prefix="ddl_chaos_")
     cache = os.path.join(root, "cache")
@@ -796,10 +799,10 @@ def _run_chaos(args) -> int:
 
     def fail(stage: str, proc) -> int:
         tail = (proc.stderr or "")[-600:]
-        print(json.dumps({
+        print(json.dumps(perf_report.annotate({
             "metric": metric, "value": None, "unit": "s per fault",
-            "error": f"{stage} run failed rc={proc.returncode}: {tail}"}),
-            flush=True)
+            "error": f"{stage} run failed rc={proc.returncode}: {tail}"},
+            provenance="error", with_backend=False)), flush=True)
         return 0
 
     def faulted_run(tag: str, run_env: dict):
@@ -882,6 +885,7 @@ def _run_chaos(args) -> int:
                 for k in ("compile_time_s", "time_to_first_step_s"):
                     if cold_summary.get(k) is not None:
                         rec[f"recovery_cold_{k}"] = cold_summary[k]
+        perf_report.annotate(rec, provenance="fresh", with_backend=False)
         print(json.dumps(rec), flush=True)
         return 0
     finally:

@@ -1,0 +1,421 @@
+"""Collective-schedule extraction, fingerprinting, and verification.
+
+An SPMD program hangs when two ranks disagree about the next collective —
+different op, different axis set, different replica groups, or simply a
+different order (Horovod guards this operationally with a background
+coordinator; PAPERS.md: arXiv 1802.05799). The MPMD direction (arXiv
+2412.14374) multiplies the number of per-stage programs whose schedules
+must agree. This module makes the schedule a first-class, *checkable*
+artifact:
+
+- :func:`extract_from_jaxpr` / :func:`extract_from_hlo_text` pull the
+  ordered collective-op sequence — kind, axis names / replica groups,
+  payload dtype+shape — out of a traced jaxpr or a lowered/compiled HLO
+  dump. Both readers are tolerant (flight.py's torn-tail rule): a
+  truncated HLO text or an unknown custom-call collective (a Pallas
+  kernel from ``csrc``, a fused op) degrades to a reported note on the
+  :class:`Schedule`, never an exception.
+- :meth:`Schedule.fingerprint` canonicalizes the sequence into a short
+  stable hash — the unit of comparison everywhere else.
+- :func:`verify_uniform` checks schedule identity across simulated
+  ranks/configs (the elastic re-formation / per-stage-program hang
+  class) and names the first divergent op when they differ.
+- :func:`verify_bucket_schedule` checks the extracted schedule against
+  the deterministic plan ``parallel/collectives.plan_buckets`` promises:
+  one ``psum`` (or ``reduce_scatter``+``all_gather`` ring pair) per
+  fusion bucket, in sorted-path bucket order.
+- :func:`check_aot_pairing` records (config fingerprint -> schedule
+  fingerprint) pairs in a sidecar registry and flags any config
+  fingerprint that maps to two different schedules — the invariant that
+  makes a ``perf/aot.py`` cache hit safe: equal keys must mean equal
+  collective schedules.
+
+Pure-stdlib except where a caller hands in jaxprs; importing this module
+never imports jax (``tools/doctor.py`` runs the AST passes jax-free).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Any, Optional, Sequence
+
+from distributeddeeplearning_tpu.analysis import finding
+
+# jaxpr primitive name -> canonical kind. psum_scatter traces as
+# `reduce_scatter` on current jax; older generations bound psum through
+# rewrite variants — map every spelling to one canonical kind so a jax
+# upgrade cannot silently change fingerprints.
+_PRIM_KINDS = {
+    "psum": "psum", "psum2": "psum", "psum_invariant": "psum",
+    "pmean": "pmean", "pmax": "pmax", "pmin": "pmin",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_gather": "all_gather", "all_gather_invariant": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute", "pshuffle": "ppermute",
+    "collective_permute": "ppermute",
+}
+
+# HLO instruction opcodes that move data across participants. Async pairs
+# (-start/-done) count once, at the -start.
+_HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute",
+                    "collective-broadcast", "custom-call")
+_HLO_OP_RE = re.compile(
+    r"=\s*(?:\(?\s*)?(?:(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[0-9,]*)\]"
+    r"[^\s]*\s+)?(?P<op>" + "|".join(_HLO_COLLECTIVES) +
+    r")(?P<async>-start|-done)?\(")
+_HLO_TARGET_RE = re.compile(r'custom_call_target="(?P<target>[^"]+)"')
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in program order — the fingerprint's unit."""
+
+    kind: str                               # canonical kind or custom-call
+    axes: Optional[tuple[str, ...]] = None  # named axes (jaxpr source)
+    groups: Optional[str] = None            # replica_groups (HLO source)
+    shape: Optional[tuple[int, ...]] = None
+    dtype: Optional[str] = None
+    note: Optional[str] = None              # e.g. unknown custom-call target
+
+    def describe(self) -> str:
+        where = (",".join(self.axes) if self.axes
+                 else (self.groups or "?"))
+        payload = (f"{self.dtype or '?'}{list(self.shape)}"
+                   if self.shape is not None else "?")
+        extra = f" [{self.note}]" if self.note else ""
+        return f"{self.kind}({where}, {payload}){extra}"
+
+    def canonical(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """The ordered collective sequence of ONE program, plus any reader
+    notes (``errors`` report, they never raise — a partial schedule from
+    torn input is still comparable and still fingerprints)."""
+
+    ops: tuple[CollectiveOp, ...]
+    source: str = "?"                    # jaxpr | hlo | label
+    errors: tuple[str, ...] = ()
+
+    def fingerprint(self) -> str:
+        blob = json.dumps([op.canonical() for op in self.ops],
+                          sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        lines = [f"{i:3d}. {op.describe()}" for i, op in enumerate(self.ops)]
+        lines += [f"  !! {e}" for e in self.errors]
+        return "\n".join(lines) or "(no collectives)"
+
+
+# ---------------------------------------------------------------------------
+# jaxpr extraction
+# ---------------------------------------------------------------------------
+
+def _normalize_axes(value) -> Optional[tuple[str, ...]]:
+    if value is None:
+        return None
+    if isinstance(value, (list, tuple)):
+        return tuple(str(a) for a in value)
+    return (str(value),)
+
+
+def _sub_jaxprs(value):
+    """Every (Closed)Jaxpr reachable from one eqn param value — how
+    shard_map/pjit/scan/cond/custom_vjp bodies are traversed without
+    naming each primitive's param layout (which drifts across jax
+    versions)."""
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        elif hasattr(v, "eqns"):                      # core.Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+            yield v.jaxpr                             # core.ClosedJaxpr
+
+
+def extract_from_jaxpr(jaxpr_like: Any) -> Schedule:
+    """Ordered collective ops of a jaxpr (recursing into shard_map / pjit
+    / scan / cond / custom_vjp sub-jaxprs). Accepts a ``ClosedJaxpr``, a
+    raw ``Jaxpr``, or anything carrying a ``.jaxpr``. Tolerant of
+    jax-version drift: an eqn whose params cannot be read is reported on
+    ``errors`` and skipped, never raised."""
+    ops: list[CollectiveOp] = []
+    errors: list[str] = []
+    root = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    if not hasattr(root, "eqns"):
+        return Schedule(ops=(), source="jaxpr",
+                        errors=(f"not a jaxpr: {type(jaxpr_like).__name__}",))
+    seen: set[int] = set()
+
+    def walk(jx) -> None:
+        if id(jx) in seen:           # defensive: shared sub-jaxprs once
+            return
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            try:
+                name = eqn.primitive.name
+                kind = _PRIM_KINDS.get(name)
+                if kind is not None:
+                    params = eqn.params
+                    axes = _normalize_axes(params.get("axes")
+                                           if "axes" in params
+                                           else params.get("axis_name"))
+                    aval = getattr(eqn.invars[0], "aval", None) \
+                        if eqn.invars else None
+                    ops.append(CollectiveOp(
+                        kind=kind, axes=axes,
+                        shape=(tuple(int(d) for d in aval.shape)
+                               if aval is not None else None),
+                        dtype=(str(aval.dtype) if aval is not None
+                               else None)))
+                for value in eqn.params.values():
+                    for sub in _sub_jaxprs(value):
+                        walk(sub)
+            except Exception as exc:  # noqa: BLE001 — jax drift tolerated
+                errors.append(f"eqn unreadable "
+                              f"({type(exc).__name__}: {exc})")
+    try:
+        walk(root)
+    except Exception as exc:  # noqa: BLE001
+        errors.append(f"jaxpr walk aborted ({type(exc).__name__}: {exc})")
+    return Schedule(ops=tuple(ops), source="jaxpr", errors=tuple(errors))
+
+
+def schedule_of(fn, *args, **kwargs) -> Schedule:
+    """Trace ``fn`` at ``args`` and extract its schedule. The one place
+    this module touches jax — import deferred so the AST-only callers
+    (doctor) stay jax-free."""
+    import jax
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    except Exception as exc:  # noqa: BLE001 — report, never crash the lint
+        return Schedule(ops=(), source="jaxpr",
+                        errors=(f"trace failed "
+                                f"({type(exc).__name__}: {exc})",))
+    return extract_from_jaxpr(jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# HLO-text extraction (tolerant reader)
+# ---------------------------------------------------------------------------
+
+def _balanced_braces(text: str, start: int) -> Optional[str]:
+    """The ``{...}`` group starting at ``start`` (nested braces counted);
+    None when the text ends before it closes — a torn dump."""
+    depth = 0
+    for i in range(start, len(text)):
+        c = text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+        elif c == "\n" and depth == 0:
+            return None
+    return None
+
+# Custom-call targets known to be collectives-in-disguise; anything else
+# is recorded as an opaque custom-call with a note (a Pallas kernel from
+# csrc/, a fused op) — part of the schedule, tolerated, never fatal.
+_KNOWN_CUSTOM_COLLECTIVES = ("allreduce", "all_reduce", "allgather",
+                             "all_gather", "reducescatter",
+                             "reduce_scatter", "alltoall", "all_to_all",
+                             "permute")
+
+
+def extract_from_hlo_text(text: str) -> Schedule:
+    """Ordered collective ops of a lowered/compiled HLO dump.
+
+    Mirrors flight.py's torn-tail rule: a truncated dump (a crashed
+    compile, a cut ``as_text()`` pipe) parses up to the tear and reports
+    it; a custom-call with an unrecognized target is recorded with a note
+    rather than rejected — the analyzer must degrade gracefully on
+    kernels it has never heard of."""
+    ops: list[CollectiveOp] = []
+    errors: list[str] = []
+    if not isinstance(text, str):
+        return Schedule(ops=(), source="hlo",
+                        errors=(f"not text: {type(text).__name__}",))
+    lines = text.splitlines()
+    if text and not text.endswith("\n") and lines:
+        errors.append(f"possibly truncated dump: last line "
+                      f"({lines[-1].strip()[:40]!r}...) has no newline; "
+                      f"parsed through it best-effort")
+    for n, line in enumerate(lines, 1):
+        try:
+            m = _HLO_OP_RE.search(line)
+            if not m:
+                continue
+            if m.group("async") == "-done":
+                continue  # counted at -start
+            op = m.group("op")
+            groups = None
+            gi = line.find("replica_groups=")
+            if gi >= 0:
+                groups = _balanced_braces(line, line.find("{", gi))
+                if groups is None:
+                    errors.append(f"line {n}: replica_groups torn "
+                                  f"mid-brace; op kept without groups")
+            shape = None
+            if m.group("dims") is not None:
+                dims = m.group("dims")
+                shape = tuple(int(d) for d in dims.split(",")) if dims \
+                    else ()
+            if op == "custom-call":
+                tm = _HLO_TARGET_RE.search(line)
+                target = tm.group("target") if tm else "?"
+                if not any(k in target.lower()
+                           for k in _KNOWN_CUSTOM_COLLECTIVES):
+                    # Opaque kernel: schedule-relevant only if it hides a
+                    # collective we cannot see — record, note, move on.
+                    ops.append(CollectiveOp(
+                        kind="custom-call", groups=groups, shape=shape,
+                        dtype=m.group("dtype"),
+                        note=f"unknown target {target!r} (tolerated)"))
+                    continue
+                ops.append(CollectiveOp(kind=f"custom-call:{target}",
+                                        groups=groups, shape=shape,
+                                        dtype=m.group("dtype")))
+                continue
+            ops.append(CollectiveOp(kind=op, groups=groups, shape=shape,
+                                    dtype=m.group("dtype")))
+        except Exception as exc:  # noqa: BLE001 — torn lines are expected
+            errors.append(f"line {n} unreadable "
+                          f"({type(exc).__name__}: {exc})")
+    return Schedule(ops=tuple(ops), source="hlo", errors=tuple(errors))
+
+
+# ---------------------------------------------------------------------------
+# Verification passes
+# ---------------------------------------------------------------------------
+
+def verify_uniform(schedules: dict[str, Schedule]) -> list[dict]:
+    """Schedule identity across ranks/configs: every label must carry the
+    same fingerprint. On divergence the finding names the first op index
+    where two labels disagree — the op a hang would park on."""
+    findings: list[dict] = []
+    if len(schedules) < 2:
+        return findings
+    items = sorted(schedules.items())
+    ref_label, ref = items[0]
+    for label, sched in items[1:]:
+        if sched.fingerprint() == ref.fingerprint():
+            continue
+        idx = next((i for i, (a, b)
+                    in enumerate(zip(ref.ops, sched.ops)) if a != b),
+                   min(len(ref.ops), len(sched.ops)))
+        a = ref.ops[idx].describe() if idx < len(ref.ops) else "(end)"
+        b = sched.ops[idx].describe() if idx < len(sched.ops) else "(end)"
+        findings.append(finding(
+            "collectives", "schedule-divergence",
+            f"collective schedules diverge between {ref_label!r} and "
+            f"{label!r} at op {idx}: {a} vs {b} — an SPMD dispatch of "
+            f"these programs deadlocks at that op"))
+    return findings
+
+
+def verify_bucket_schedule(schedule: Schedule, plan, algorithm: str,
+                           axis_size: int) -> list[dict]:
+    """The extracted schedule of an ``all_reduce`` over ``plan`` must be
+    exactly the planner's promise: buckets in sorted-path order, one
+    ``psum`` each (or a ``reduce_scatter``+``all_gather`` pair for the
+    ring form). Anything else means the planner and the traced program
+    have drifted apart — the determinism the AOT cache leans on."""
+    per_bucket = (("psum",) if algorithm == "psum" or axis_size <= 1
+                  else ("reduce_scatter", "all_gather"))
+    expected = list(per_bucket) * len(plan.buckets)
+    got = [op.kind for op in schedule.ops]
+    if got == expected:
+        return []
+    return [finding(
+        "collectives", "bucket-order",
+        f"bucket schedule mismatch vs parallel/collectives planner: "
+        f"expected {len(plan.buckets)} bucket(s) x {per_bucket} = "
+        f"{expected}, traced program issues {got}")]
+
+
+def plan_is_deterministic(tree_builder, plan_buckets, *,
+                          bucket_bytes: int) -> list[dict]:
+    """Insertion-order independence of the bucket planner: ``tree_builder``
+    must return the same logical tree under different container insertion
+    orders; the plans (and thus schedules) must be identical."""
+    import random
+    base = plan_buckets(tree_builder(shuffle=None),
+                        bucket_bytes=bucket_bytes)
+    for seed in (1, 2):
+        rng = random.Random(seed)
+        other = plan_buckets(tree_builder(shuffle=rng),
+                             bucket_bytes=bucket_bytes)
+        if (base.paths, base.buckets) != (other.paths, other.buckets):
+            return [finding(
+                "collectives", "bucket-order",
+                f"plan_buckets is insertion-order dependent (seed {seed}): "
+                f"{base.buckets} vs {other.buckets} — two hosts building "
+                f"the same gradient tree in different dict orders would "
+                f"issue different collective schedules")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# AOT pairing registry (config fingerprint <-> schedule fingerprint)
+# ---------------------------------------------------------------------------
+
+REGISTRY_SIDECAR = "schedule_fingerprints"
+
+
+def check_aot_pairing(config_fp: str, program: str, schedule_fp: str,
+                      registry_path: Optional[str] = None,
+                      record: bool = True) -> list[dict]:
+    """Cross-check a (perf/aot.py config fingerprint, program name) pair
+    against the recorded schedule fingerprint. A divergence means an AOT
+    cache hit keyed by that config could execute a different collective
+    schedule than the one on record — exactly the pairing the cache's
+    "equal keys => equal programs" contract forbids. First sighting is
+    recorded (when ``record``), matches are silent."""
+    from distributeddeeplearning_tpu.observability import sidecars
+    target = registry_path or REGISTRY_SIDECAR
+    side = sidecars.read(target) or {}
+    pairs = side.get("pairs") if isinstance(side.get("pairs"), dict) else {}
+    key = f"{config_fp}/{program}"
+    prior = pairs.get(key)
+    if prior is not None and prior != schedule_fp:
+        return [finding(
+            "collectives", "aot-schedule-pairing",
+            f"config fingerprint {config_fp} program {program!r} now "
+            f"traces schedule {schedule_fp} but {prior} is on record — "
+            f"an AOT cache hit under this key would pair a cached "
+            f"executable with a divergent collective schedule "
+            f"(delete the registry entry after an intentional change)")]
+    if prior is None and record:
+        pairs = dict(pairs)
+        pairs[key] = schedule_fp
+        sidecars.write(target, {"pairs": pairs})
+    return []
+
+
+def simulate_ranks(make_schedule, ranks: Sequence[int]) -> dict[str, Schedule]:
+    """Trace one schedule per simulated rank. SPMD programs must not
+    branch on the process index; this surfaces the ones that do.
+    ``make_schedule(rank)`` is called with ``jax.process_index`` patched
+    to return ``rank`` (the env-var route launch.py children use is
+    resolved before tracing, so patching the query function is the
+    faithful simulation)."""
+    import unittest.mock
+
+    import jax
+    out: dict[str, Schedule] = {}
+    for rank in ranks:
+        with unittest.mock.patch.object(jax, "process_index",
+                                        return_value=int(rank)):
+            out[f"rank{rank}"] = make_schedule(rank)
+    return out

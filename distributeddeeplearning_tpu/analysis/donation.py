@@ -1,0 +1,310 @@
+"""Donation/aliasing hazard pass — the PR 5 / PR 9 invariant, statically.
+
+The invariant (train/loop.py, train/checkpoint.py): an array that came out
+of a checkpoint restore — or any orbax-aliased / snapshot-shared buffer —
+must pass through ``checkpoint.device_copy`` before it may reach a donated
+parameter of a compiled step. Violating it is not a crash at the violation
+site: the donated-over buffer is memory orbax still owns, so the live state
+(and every checkpoint saved from it) silently turns to garbage a few steps
+later (PR 5: SIGSEGV steps after a warm resume through a deserialized AOT
+executable; PR 9: async cadence saves serializing zero-copy views the next
+step had already donated over). Both were found at runtime by the flight
+recorder; this pass encodes the rule so the corpus in
+``tests/test_ddl_lint.py`` proves it would have caught each statically.
+
+Mechanics: an intra-procedural AST taint walk per function.
+
+- *Sources*: calls whose terminal name contains ``restore`` (``
+  restore_latest``, ``restore_latest_for_eval``, ``restore_latest_params``,
+  orbax ``StandardRestore`` wrappers) taint their assigned names.
+- *Sanitizer*: assignment through a ``device_copy(...)`` call kills taint —
+  the copy allocates fresh XLA-owned buffers.
+- *Sinks*: argument positions of *donating callees* — names bound from
+  ``jax.jit(..., donate_argnums=...)`` in the same module, plus the
+  configured cross-module dispatch names (``train_step``/``fused_runner``
+  are function parameters at their loop.py call site, invisible to a
+  module-local scan).
+- Branches union: a name is tainted after an ``if`` when EITHER arm leaves
+  it tainted (the hazard only needs one path). Results of ordinary calls
+  are treated clean — this pass prefers a miss over a false positive,
+  because the gate fails tier-1 and a noisy gate gets baselined into
+  uselessness.
+
+Separately, :func:`check_snapshot_before_save` encodes the PR 9 save-side
+rule as a lexical-presence check: a function that hands state to orbax
+``StandardSave`` must call ``device_copy`` somewhere before the save (the
+snapshot that makes an async save immune to later donation). Presence, not
+path-sensitivity, on purpose: checkpoint.py legitimately snapshots under a
+backend conditional, and a branch-union would false-positive on it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from distributeddeeplearning_tpu.analysis import finding, iter_py_files
+
+# Dispatch names that donate their first arg but are bound cross-module
+# (function parameters at the call site, so a module-local
+# jax.jit(donate_argnums=...) scan cannot see them).
+DONATING_CALLEES = ("train_step", "fused_runner", "jitted_step")
+
+SANITIZERS = ("device_copy",)
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _shallow_walk(fn: ast.AST):
+    """Function-body walk that does NOT descend into nested function
+    definitions (they are separate scopes, visited by the module walk)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_source_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _terminal_name(node.func) or ""
+    return "restore" in name.lower()
+
+
+def _is_sanitizer_call(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Call)
+            and _terminal_name(node.func) in SANITIZERS)
+
+
+def module_donating_callees(tree: ast.Module) -> set[str]:
+    """Names bound (anywhere in the module) from a ``jax.jit`` /
+    ``jit`` call that passes ``donate_argnums``/``donate_argnames``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = getattr(node, "value", None)
+        if not (isinstance(value, ast.Call)
+                and _terminal_name(value.func) in ("jit", "pjit")):
+            continue
+        if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in value.keywords):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+class _TaintWalker:
+    """One function's worth of flow-insensitive-within-expression,
+    flow-sensitive-across-statement taint."""
+
+    def __init__(self, donating: set[str], path: str):
+        self.donating = donating
+        self.path = path
+        self.findings: list[dict] = []
+
+    # -- expression taint ------------------------------------------------
+    def _expr_tainted(self, node: ast.expr, taint: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in taint
+        if isinstance(node, ast.Call):
+            if _is_sanitizer_call(node):
+                return False
+            return _is_source_call(node)
+        if isinstance(node, ast.IfExp):
+            return (self._expr_tainted(node.body, taint)
+                    or self._expr_tainted(node.orelse, taint))
+        if isinstance(node, ast.BoolOp):
+            return any(self._expr_tainted(v, taint) for v in node.values)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e, taint) for e in node.elts)
+        if isinstance(node, ast.Attribute):
+            # state.params of a tainted state aliases the same buffers.
+            return self._expr_tainted(node.value, taint)
+        if isinstance(node, ast.Subscript):
+            return self._expr_tainted(node.value, taint)
+        if isinstance(node, ast.NamedExpr):
+            return self._expr_tainted(node.value, taint)
+        return False
+
+    def _check_sinks(self, node: ast.expr, taint: set[str]) -> None:
+        for call in [n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)]:
+            callee = _terminal_name(call.func)
+            if callee not in self.donating:
+                continue
+            for arg in list(call.args) + [kw.value
+                                          for kw in call.keywords]:
+                if self._expr_tainted(arg, taint):
+                    named = (arg.id if isinstance(arg, ast.Name)
+                             else ast.unparse(arg)[:40])
+                    self.findings.append(finding(
+                        "donation", "donation-hazard",
+                        f"restored/aliased value {named!r} reaches "
+                        f"donating callee {callee}() without "
+                        f"checkpoint.device_copy — the donated-over "
+                        f"buffer still aliases restore-owned memory "
+                        f"(the PR 5 warm-resume corruption)",
+                        file=self.path, line=call.lineno))
+
+    # -- statement walk --------------------------------------------------
+    def _assign_targets(self, targets: Iterable[ast.expr],
+                        tainted: bool, taint: set[str]) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                (taint.add if tainted else taint.discard)(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                # A tainted RHS (tuple-returning source) taints every
+                # element; a clean RHS cleans them.
+                self._assign_targets(t.elts, tainted, taint)
+            elif isinstance(t, ast.Starred):
+                self._assign_targets([t.value], tainted, taint)
+
+    def walk_body(self, body: Sequence[ast.stmt],
+                  taint: set[str]) -> set[str]:
+        for stmt in body:
+            taint = self._walk_stmt(stmt, taint)
+        return taint
+
+    def _walk_stmt(self, stmt: ast.stmt, taint: set[str]) -> set[str]:
+        # Sinks first: the RHS executes before the assignment rebinds.
+        for value in ast.iter_child_nodes(stmt):
+            if isinstance(value, ast.expr):
+                self._check_sinks(value, taint)
+        if isinstance(stmt, ast.Assign):
+            tainted = self._expr_tainted(stmt.value, taint)
+            self._assign_targets(stmt.targets, tainted, taint)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign_targets([stmt.target],
+                                 self._expr_tainted(stmt.value, taint),
+                                 taint)
+        elif isinstance(stmt, ast.AugAssign):
+            pass  # x += tainted keeps x's prior status; too noisy to flag
+        elif isinstance(stmt, ast.If):
+            a = self.walk_body(stmt.body, set(taint))
+            b = self.walk_body(stmt.orelse, set(taint))
+            taint = a | b  # hazard needs only one arm
+        elif isinstance(stmt, (ast.For, ast.While)):
+            # Two passes approximate the loop fixpoint (taint introduced
+            # on iteration 1 reaches sinks on pass 2).
+            for _ in range(2):
+                taint |= self.walk_body(stmt.body, set(taint))
+            taint |= self.walk_body(stmt.orelse, set(taint))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            taint = self.walk_body(stmt.body, taint)
+        elif isinstance(stmt, ast.Try):
+            t = self.walk_body(stmt.body, set(taint))
+            for handler in stmt.handlers:
+                t |= self.walk_body(handler.body, set(taint))
+            t |= self.walk_body(stmt.orelse, set(t))
+            taint = self.walk_body(stmt.finalbody, t)
+        return taint
+
+
+def analyze_tree(tree: ast.Module, path: str, *,
+                 donating_callees: Optional[Sequence[str]] = None
+                 ) -> list[dict]:
+    donating = set(donating_callees if donating_callees is not None
+                   else DONATING_CALLEES)
+    donating |= module_donating_callees(tree)
+    findings: list[dict] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        walker = _TaintWalker(donating, path)
+        walker.walk_body(node.body, set())
+        findings.extend(walker.findings)
+    findings.extend(check_snapshot_before_save(tree, path))
+    return findings
+
+
+def check_snapshot_before_save(tree: ast.Module, path: str) -> list[dict]:
+    """Any function handing state to orbax ``StandardSave`` must call
+    ``device_copy`` lexically before the save call (the PR 9 async-save
+    snapshot). Presence-based on purpose — see module docstring.
+
+    Exemption that tracks the actual hazard: a save the same function
+    blocks on (``wait_until_finished`` lexically after it) cannot race a
+    later donation — the buffers are fully read before anyone could
+    donate them (tools/import_hf.py's one-shot conversion save)."""
+    findings: list[dict] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        save_line = None
+        copy_line = None
+        wait_line = None
+        for sub in _shallow_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _terminal_name(sub.func)
+            if name in SANITIZERS:
+                copy_line = (sub.lineno if copy_line is None
+                             else min(copy_line, sub.lineno))
+            elif name == "wait_until_finished":
+                wait_line = (sub.lineno if wait_line is None
+                             else max(wait_line, sub.lineno))
+            elif name == "save" and any(
+                    isinstance(a, ast.Call)
+                    and _terminal_name(a.func) == "StandardSave"
+                    for a in list(sub.args)
+                    + [kw.value for kw in sub.keywords]):
+                save_line = (sub.lineno if save_line is None
+                             else min(save_line, sub.lineno))
+        if save_line is not None and wait_line is not None \
+                and wait_line > save_line:
+            continue
+        if save_line is not None and (copy_line is None
+                                      or copy_line > save_line):
+            findings.append(finding(
+                "donation", "snapshot-before-save",
+                f"{node.name}() hands state to orbax StandardSave with "
+                f"no checkpoint.device_copy before it — an async save "
+                f"can serialize zero-copy views a later step donates "
+                f"over (the PR 9 silent-corruption bug)",
+                file=path, line=save_line))
+    return findings
+
+
+def analyze_source(src: str, path: str = "<memory>", *,
+                   donating_callees: Optional[Sequence[str]] = None
+                   ) -> list[dict]:
+    """Entry point for the seeded-violation corpus (no file needed)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [finding("donation", "unparseable",
+                        f"cannot parse: {exc}", file=path,
+                        line=exc.lineno)]
+    return analyze_tree(tree, path, donating_callees=donating_callees)
+
+
+def analyze_file(path: str, *,
+                 donating_callees: Optional[Sequence[str]] = None
+                 ) -> list[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+    except OSError as exc:
+        return [finding("donation", "unparseable",
+                        f"cannot read: {exc}", file=path)]
+    return analyze_source(src, path, donating_callees=donating_callees)
+
+
+def analyze_paths(roots: Sequence[str]) -> list[dict]:
+    findings: list[dict] = []
+    for path in iter_py_files(roots):
+        findings.extend(analyze_file(path))
+    return findings

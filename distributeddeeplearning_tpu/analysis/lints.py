@@ -1,0 +1,361 @@
+"""Repo-invariant AST lints — conventions the repo already bled for.
+
+Each rule here encodes a convention an earlier PR introduced for a
+concrete failure mode, now checked mechanically so the next subsystem
+cannot quietly regress it:
+
+- ``sidecar-direct-write``: every ``.cache/*.json`` run sidecar goes
+  through ``observability/sidecars.py`` (atomic rename, envelope with
+  ``schema``/``written_at``, never-raise). A direct ``open``/``json.dump``
+  is a torn-read and stale-data hazard the sidecar API exists to close.
+- ``fsync-before-fire``: a function that kills its own process
+  (``os.kill(os.getpid(), ...)`` — the faults.py chaos emitters) must
+  have put a flight record / flush on disk first, or the post-mortem
+  loses the one event that explains the death.
+- ``unpaired-telemetry-span``: ``telemetry.span(...)`` returns a context
+  manager; a call whose result is discarded times nothing and silently
+  drops the phase from every trace and perf-gate phase-mix check.
+- ``perf-record-provenance``: every serialized perf record (a dict with
+  a ``"metric"`` key) carries a ``perf_report.annotate`` provenance stamp
+  — PR 6's rule that perf claims are dated, attributed, and
+  staleness-graded or they don't exist.
+- ``axis-name-consistency``: string axis names at ``psum`` /
+  ``psum_scatter`` / ``all_gather`` / ``pmean`` / ... call sites must be
+  declared in ``parallel/mesh.py``'s ``MESH_AXES`` — a typo'd axis name
+  is an obscure trace error at best and a wrong-group collective at
+  worst. Module-level tuple constants (``DATA_AXES``-style) are resolved;
+  dynamic values are out of static reach and skipped.
+
+All rules are AST-only (no imports of the linted code, no jax) and are
+tuned to zero false positives on this repo — the gate fails tier-1, and
+a noisy gate gets baselined into uselessness.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional, Sequence
+
+from distributeddeeplearning_tpu.analysis import (finding, iter_py_files,
+                                                  repo_root)
+
+# Files exempt from sidecar-direct-write: the sidecar implementation
+# itself, and the doctor (read-only display of raw paths).
+_SIDECAR_EXEMPT = ("observability/sidecars.py",)
+
+_COLLECTIVE_CALLS = {"psum", "psum_scatter", "all_gather", "pmean",
+                     "pmax", "pmin", "all_to_all", "ppermute",
+                     "reduce_scatter"}
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _shallow_walk(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested function
+    definitions — those are visited as their own scope by the outer
+    ``ast.walk`` over the module, and double-visiting them both
+    duplicates findings and mixes scopes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# sidecar-direct-write
+# ---------------------------------------------------------------------------
+
+def check_sidecar_writes(tree: ast.Module, path: str) -> list[dict]:
+    rel = os.path.relpath(os.path.abspath(path), repo_root())
+    if rel.replace(os.sep, "/").endswith(_SIDECAR_EXEMPT):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        consts = [c for c in (_const_str(a) for a in node.args)
+                  if c is not None]
+        hit = None
+        if name == "join" and ".cache" in consts and any(
+                c.endswith(".json") for c in consts):
+            hit = next(c for c in consts if c.endswith(".json"))
+        elif name == "open" and node.args:
+            c = _const_str(node.args[0])
+            if c and ".cache/" in c.replace(os.sep, "/") \
+                    and c.endswith(".json"):
+                hit = c
+        if hit:
+            findings.append(finding(
+                "lints", "sidecar-direct-write",
+                f"direct .cache sidecar path {hit!r} — route through "
+                f"observability/sidecars.py (path_for/write/read) for "
+                f"atomic rename + schema/written_at envelope",
+                file=path, line=node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# fsync-before-fire
+# ---------------------------------------------------------------------------
+
+def _is_self_kill(call: ast.Call) -> bool:
+    if _terminal_name(call.func) != "kill" or not call.args:
+        return False
+    first = call.args[0]
+    return (isinstance(first, ast.Call)
+            and _terminal_name(first.func) == "getpid")
+
+
+def check_fsync_before_fire(tree: ast.Module, path: str) -> list[dict]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        durable_line = None  # earliest record/fsync/flush
+        kill_lines: list[int] = []
+        for sub in _shallow_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _terminal_name(sub.func)
+            if name in ("record", "fsync", "flush"):
+                durable_line = (sub.lineno if durable_line is None
+                                else min(durable_line, sub.lineno))
+            elif _is_self_kill(sub):
+                kill_lines.append(sub.lineno)
+        for kill_line in sorted(kill_lines):
+            if durable_line is None or durable_line > kill_line:
+                findings.append(finding(
+                    "lints", "fsync-before-fire",
+                    f"{node.name}() kills its own process with no "
+                    f"flight record / fsync / flush before the kill "
+                    f"— the event that explains the death dies "
+                    f"with the process",
+                    file=path, line=kill_line))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# unpaired-telemetry-span
+# ---------------------------------------------------------------------------
+
+def check_unpaired_spans(tree: ast.Module, path: str) -> list[dict]:
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and _terminal_name(node.value.func) == "span"):
+            continue
+        findings.append(finding(
+            "lints", "unpaired-telemetry-span",
+            "span(...) result discarded — it is a context manager; a "
+            "span never entered times nothing and the phase vanishes "
+            "from traces and the perf gate's phase mix "
+            "(use `with tele.span(...):`)",
+            file=path, line=node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# perf-record-provenance
+# ---------------------------------------------------------------------------
+
+def _is_metric_dict(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Dict)
+            and any(_const_str(k) == "metric"
+                    for k in node.keys if k is not None))
+
+
+def check_perf_record_provenance(tree: ast.Module, path: str) -> list[dict]:
+    """``json.dump(s)`` of a perf record (dict with a ``"metric"`` key,
+    literal or via a local name) must be stamped: either the dumps arg is
+    an ``annotate(...)`` call, or ``annotate(<name>, ...)`` ran lexically
+    earlier in the same function."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        metric_names: dict[str, int] = {}   # name -> assign line
+        annotated: dict[str, int] = {}      # name -> annotate line
+        dumps: list[ast.Call] = []
+        for sub in _shallow_walk(node):
+            if isinstance(sub, ast.Assign) and _is_metric_dict(sub.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        metric_names[t.id] = sub.lineno
+            elif isinstance(sub, ast.Call):
+                name = _terminal_name(sub.func)
+                if name == "annotate" and sub.args:
+                    a = sub.args[0]
+                    if isinstance(a, ast.Name):
+                        annotated[a.id] = min(
+                            annotated.get(a.id, sub.lineno), sub.lineno)
+                elif name in ("dumps", "dump") and sub.args:
+                    dumps.append(sub)
+        for call in dumps:
+            arg = call.args[0]
+            if isinstance(arg, ast.Call) \
+                    and _terminal_name(arg.func) == "annotate":
+                continue
+            bad = None
+            if _is_metric_dict(arg):
+                bad = "a literal perf record"
+            elif isinstance(arg, ast.Name) and arg.id in metric_names:
+                if arg.id in annotated \
+                        and annotated[arg.id] < call.lineno:
+                    continue
+                bad = f"perf record {arg.id!r}"
+            if bad:
+                findings.append(finding(
+                    "lints", "perf-record-provenance",
+                    f"{bad} serialized without a perf_report.annotate "
+                    f"provenance stamp — perf claims must carry "
+                    f"fresh/stale grading, git rev, and attempt "
+                    f"history (PR 6 rule)",
+                    file=path, line=call.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# axis-name-consistency
+# ---------------------------------------------------------------------------
+
+def declared_mesh_axes(mesh_path: Optional[str] = None) -> Optional[set]:
+    """``MESH_AXES`` from parallel/mesh.py, by AST (no import)."""
+    mesh_path = mesh_path or os.path.join(
+        repo_root(), "distributeddeeplearning_tpu", "parallel", "mesh.py")
+    try:
+        tree = ast.parse(open(mesh_path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                   for t in targets):
+            continue
+        if isinstance(value, (ast.Tuple, ast.List)):
+            axes = {_const_str(e) for e in value.elts}
+            if None not in axes:
+                return axes
+    return None
+
+
+def _module_tuple_consts(tree: ast.Module) -> dict[str, tuple[str, ...]]:
+    """Module-level ``NAME = ("a", "b")`` string-tuple constants —
+    resolvable axis aliases like steps.py's ``DATA_AXES``."""
+    out: dict[str, tuple[str, ...]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            vals = tuple(_const_str(e) for e in node.value.elts)
+            if vals and None not in vals:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = vals
+    return out
+
+
+def check_axis_names(tree: ast.Module, path: str,
+                     mesh_axes: Optional[set] = None) -> list[dict]:
+    if mesh_axes is None:
+        mesh_axes = declared_mesh_axes()
+    if not mesh_axes:
+        return []  # mesh.py unreadable: tolerate, never guess
+    aliases = _module_tuple_consts(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _COLLECTIVE_CALLS:
+            continue
+        axis_arg = None
+        if len(node.args) >= 2:
+            axis_arg = node.args[1]
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axes", "axis_names"):
+                axis_arg = kw.value
+        if axis_arg is None:
+            continue
+        names: list[str] = []
+        if _const_str(axis_arg) is not None:
+            names = [_const_str(axis_arg)]
+        elif isinstance(axis_arg, (ast.Tuple, ast.List)):
+            vals = [_const_str(e) for e in axis_arg.elts]
+            if None in vals:
+                continue  # dynamic element: out of static reach
+            names = vals
+        elif isinstance(axis_arg, ast.Name) and axis_arg.id in aliases:
+            names = list(aliases[axis_arg.id])
+        for name in names:
+            if name not in mesh_axes:
+                findings.append(finding(
+                    "lints", "axis-name-consistency",
+                    f"axis {name!r} at this "
+                    f"{_terminal_name(node.func)}() call is not "
+                    f"declared in parallel/mesh.py MESH_AXES "
+                    f"{sorted(mesh_axes)} — a typo'd axis is a "
+                    f"wrong-group collective",
+                    file=path, line=node.lineno))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_CHECKS = (check_sidecar_writes, check_fsync_before_fire,
+           check_unpaired_spans, check_perf_record_provenance)
+
+
+def analyze_source(src: str, path: str = "<memory>", *,
+                   mesh_axes: Optional[set] = None) -> list[dict]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [finding("lints", "unparseable", f"cannot parse: {exc}",
+                        file=path, line=exc.lineno)]
+    findings: list[dict] = []
+    for check in _CHECKS:
+        findings.extend(check(tree, path))
+    findings.extend(check_axis_names(tree, path, mesh_axes))
+    return findings
+
+
+def analyze_file(path: str, *, mesh_axes: Optional[set] = None
+                 ) -> list[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+    except OSError as exc:
+        return [finding("lints", "unparseable", f"cannot read: {exc}",
+                        file=path)]
+    return analyze_source(src, path, mesh_axes=mesh_axes)
+
+
+def analyze_paths(roots: Sequence[str]) -> list[dict]:
+    mesh_axes = declared_mesh_axes()
+    findings: list[dict] = []
+    for path in iter_py_files(roots):
+        findings.extend(analyze_file(path, mesh_axes=mesh_axes))
+    return findings

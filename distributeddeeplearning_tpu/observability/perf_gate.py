@@ -41,12 +41,13 @@ import statistics
 import time
 from typing import Any, Optional
 
-from distributeddeeplearning_tpu.observability import perf_report, telemetry
+from distributeddeeplearning_tpu.observability import (perf_report,
+                                                       sidecars, telemetry)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 BASELINE_PATH = os.path.join(_REPO_ROOT, "perf_baselines.json")
-LAST_RESULT_PATH = os.path.join(_REPO_ROOT, ".cache", "perf_gate_last.json")
+LAST_RESULT_PATH = sidecars.path_for("perf_gate_last")
 
 SCHEMA_VERSION = 1
 
@@ -269,12 +270,9 @@ def compare(baseline: Optional[dict], current: dict,
 
 
 def _write_sidecar(result: dict) -> None:
-    try:
-        os.makedirs(os.path.dirname(LAST_RESULT_PATH), exist_ok=True)
-        with open(LAST_RESULT_PATH, "w") as fh:
-            json.dump(result, fh)
-    except OSError:
-        pass  # the sidecar is for doctor.py; losing it costs no gate run
+    # Atomic + enveloped via sidecars.write (never raises): the sidecar
+    # is for doctor.py; losing it costs no gate run.
+    sidecars.write(LAST_RESULT_PATH, result)
 
 
 def check(baseline_path: Optional[str] = None,
@@ -380,12 +378,9 @@ def status(baseline_path: Optional[str] = None) -> dict:
         out["baseline_recorded"] = baseline.get("recorded", {})
         out["tolerance"] = baseline.get("tolerance", {})
         out["extra_baselines"] = sorted((baseline.get("extras") or {}))
-    try:
-        with open(LAST_RESULT_PATH) as fh:
-            last = json.load(fh)
-        out["last_check"] = {
-            k: last.get(k) for k in ("ok", "violations", "checked_at",
-                                     "git_rev")}
-    except (OSError, ValueError):
-        out["last_check"] = None
+    last = sidecars.read(LAST_RESULT_PATH)
+    out["last_check"] = ({
+        k: last.get(k) for k in ("ok", "violations", "checked_at",
+                                 "git_rev")}
+        if last is not None else None)
     return out

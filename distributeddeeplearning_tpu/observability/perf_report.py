@@ -202,7 +202,36 @@ def annotate(rec: dict, *, provenance: str,
                 config, total_steps=total_steps)
         except Exception:
             pass  # fingerprint is annotation; its absence is visible anyway
+    if provenance != "error":
+        schedules = lint_schedules()
+        if schedules:
+            rec.setdefault("collective_schedules", schedules)
     return rec
+
+
+# Schedule fingerprints older than this describe some other build, not
+# the one being measured; the chip window runs ddl_lint minutes before
+# bench, so a day is generous without re-surfacing ancient runs.
+LINT_SCHEDULES_MAX_AGE_S = 24 * 3600.0
+
+
+def lint_schedules() -> Optional[dict]:
+    """Collective-schedule fingerprints from the last ddl_lint run
+    (tools/ddl_lint.py's ``last_ddl_lint`` sidecar) — attached to perf
+    records so a throughput number names the collective schedule it was
+    measured under. ``None`` when absent, stale, or unreadable (pure
+    annotation, never a failure)."""
+    try:
+        from distributeddeeplearning_tpu.observability import sidecars
+        side = sidecars.read("last_ddl_lint")
+        age = sidecars.age_s(side)
+        schedules = (side or {}).get("collective_schedules")
+        if (isinstance(schedules, dict) and schedules
+                and age is not None and age < LINT_SCHEDULES_MAX_AGE_S):
+            return dict(schedules)
+    except Exception:  # noqa: BLE001 — annotation only
+        pass
+    return None
 
 
 def validate(rec: dict) -> list[str]:

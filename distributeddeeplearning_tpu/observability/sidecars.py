@@ -18,9 +18,14 @@ through ``write()`` / ``read()``:
 Known sidecar names (the registry is deliberately just a tuple — the
 point is a shared shape, not a gatekeeper):
 
-    last_run_sharding   train/loop.py — sharding/overlap of the last run
-    last_elastic_event  train/loop.py — last elastic re-formation
-    last_bench          bench.py — last benchmark record
+    last_run_sharding      train/loop.py — sharding/overlap of the last run
+    last_elastic_event     train/loop.py — last elastic re-formation
+    last_bench             bench.py — per-metric last good measurements
+    perf_gate_last         observability/perf_gate.py — last gate result
+    last_ddl_lint          tools/ddl_lint.py — last analyzer run + schedule
+                           fingerprints
+    schedule_fingerprints  analysis/collectives.py — config-fp -> schedule-fp
+                           pairing registry for the AOT cache cross-check
 
 Pure stdlib; safe to import from jax-free tools.
 """
@@ -33,7 +38,8 @@ from typing import Any, Optional
 
 SCHEMA_VERSION = 1
 
-KNOWN = ("last_run_sharding", "last_elastic_event", "last_bench")
+KNOWN = ("last_run_sharding", "last_elastic_event", "last_bench",
+         "perf_gate_last", "last_ddl_lint", "schedule_fingerprints")
 
 
 def cache_dir() -> str:

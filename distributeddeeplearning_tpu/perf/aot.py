@@ -119,6 +119,29 @@ def _aval_signature(args) -> list:
               str(getattr(x, "dtype", type(x).__name__))) for x in leaves]]
 
 
+def donation_signature(compiled_exec) -> Optional[str]:
+    """The executable's ``input_output_alias`` header from its HLO text —
+    the compiled encoding of which inputs were donated. ``None`` when the
+    text or header is unavailable (older jax, partial dumps): the caller
+    treats that as "cannot check", never as a mismatch."""
+    try:
+        text = compiled_exec.as_text()
+        marker = "input_output_alias="
+        start = text.index(marker) + len(marker)
+        brace = text.index("{", start)
+        depth = 0
+        for i in range(brace, min(len(text), brace + 100_000)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    return "".join(text[brace:i + 1].split())
+        return None
+    except Exception:  # noqa: BLE001 — absence of evidence, not mismatch
+        return None
+
+
 class StepExecutableCache:
     """Fingerprint-keyed store of serialized step executables.
 
@@ -184,6 +207,19 @@ class StepExecutableCache:
             fn = serialize_executable.deserialize_and_load(
                 payload["executable"], payload["in_tree"],
                 payload["out_tree"])
+            # Donation backstop (the PR 5 bug class, cheap runtime form of
+            # analysis/donation.py): the deserialized executable must
+            # donate exactly the inputs it donated when saved. A drifted
+            # donation set means a dispatch through this hit could donate
+            # buffers the caller still aliases — delete + recompile cold.
+            saved_donation = payload.get("donation")
+            live_donation = donation_signature(fn)
+            if (saved_donation is not None and live_donation is not None
+                    and saved_donation != live_donation):
+                raise ValueError(
+                    f"donation set drifted: saved "
+                    f"input_output_alias {saved_donation} != deserialized "
+                    f"{live_donation}")
         except Exception as exc:  # noqa: BLE001 - any mismatch = cold path
             self.failures += 1
             self.misses += 1
@@ -218,6 +254,7 @@ class StepExecutableCache:
                 "executable": executable,
                 "in_tree": in_tree,
                 "out_tree": out_tree,
+                "donation": donation_signature(compiled_exec),
                 "saved_at": time.time(),
             })
             os.makedirs(self.dir, exist_ok=True)

@@ -56,6 +56,9 @@ def pytest_runtest_logreport(report):
         # flight likewise: tools/marker_audit.py --expect-flight verifies
         # the crash-surviving flight record is exercised in tier-1.
         "flight": "flight" in report.keywords,
+        # lint likewise: tools/marker_audit.py --expect-lint verifies the
+        # ddl-lint static-analysis gate actually ran in this tier-1 pass.
+        "lint": "lint" in report.keywords,
     })
 
 

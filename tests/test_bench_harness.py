@@ -483,9 +483,13 @@ def test_last_good_cache_keyed_per_metric(bench, tmp_path):
     bench._record_last_good(json.dumps({"metric": "a", "value": 1}))
     bench._record_last_good(json.dumps({"metric": "b", "value": 2}))
     bench._record_last_good(json.dumps({"metric": "a", "value": 3}))
+    # Written through the sidecars envelope: metrics table nested, plus
+    # schema/written_at stamps.
     with open(bench.LAST_GOOD_PATH) as f:
-        table = json.load(f)
+        side = json.load(f)
+    table = side["metrics"]
     assert table["a"]["value"] == 3 and table["b"]["value"] == 2
+    assert "written_at" in side and "schema" in side
 
 
 # --- provenance schema on bench records (ISSUE 6 tentpole) ------------------

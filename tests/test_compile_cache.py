@@ -339,3 +339,73 @@ def test_warm_resume_with_checkpointing_is_donation_safe(tmp_path, monkeypatch):
     finally:
         jax.config.update("jax_compilation_cache_dir",
                           compile_cache.default_dir())
+
+
+# ---------------------------------------------------------------------------
+# Donation backstop (the runtime form of analysis/donation.py's invariant)
+# ---------------------------------------------------------------------------
+
+def test_donation_signature_parses_alias_header():
+    class Fake:
+        def as_text(self):
+            return ("HloModule m, input_output_alias={ {0}: (0, {}, "
+                    "may-alias) }\n\nENTRY %main () -> f32[] {\n}\n")
+
+    assert aot.donation_signature(Fake()) == "{{0}:(0,{},may-alias)}"
+
+    class NoAlias:
+        def as_text(self):
+            return "HloModule m\n"
+
+    assert aot.donation_signature(NoAlias()) is None
+
+    class Broken:
+        def as_text(self):
+            raise RuntimeError("boom")
+
+    assert aot.donation_signature(Broken()) is None
+
+
+@pytest.mark.usefixtures("devices8")
+def test_aot_load_rejects_drifted_donation_set(tmp_path, monkeypatch):
+    """A cached executable whose input_output_alias no longer matches the
+    one recorded at save time could donate buffers the caller still
+    aliases (the PR 5 bug class, through the cache): the entry must be
+    deleted and recompiled cold, never dispatched. CPU executables carry
+    no alias header, so the signature probe is patched to simulate the
+    TPU donation sets."""
+    cache = str(tmp_path / "cache")
+    handle = aot.StepExecutableCache.for_config(_cfg(), total_steps=4,
+                                                cache_dir=cache)
+    args = (jnp.ones((4,)), jnp.ones((4,)))
+    compiled = jax.jit(lambda x, y: x + y).lower(*args).compile()
+    key = handle.key("step", args)
+
+    monkeypatch.setattr(aot, "donation_signature", lambda _: "{{0}:(0,{})}")
+    assert handle.save("step", key, compiled)
+
+    # Unchanged donation set: a hit.
+    warm = aot.StepExecutableCache.for_config(_cfg(), total_steps=4,
+                                              cache_dir=cache)
+    assert warm.load("step", key) is not None
+    assert warm.hits == 1 and warm.failures == 0
+
+    # Drifted donation set: deleted + cold fallback.
+    monkeypatch.setattr(aot, "donation_signature", lambda _: "{{1}:(0,{})}")
+    drifted = aot.StepExecutableCache.for_config(_cfg(), total_steps=4,
+                                                 cache_dir=cache)
+    assert drifted.load("step", key) is None
+    assert drifted.failures == 1 and drifted.hits == 0
+    assert not os.path.exists(os.path.join(
+        cache, compile_cache.AOT_SUBDIR, f"{key}.aotx"))
+
+    # Payloads with no recorded signature (pre-backstop entries, or a
+    # backend whose text lacks the header) are tolerated: absence of
+    # evidence is not a mismatch.
+    monkeypatch.setattr(aot, "donation_signature", lambda _: None)
+    assert handle.save("step", key, compiled)
+    monkeypatch.setattr(aot, "donation_signature", lambda _: "{{0}:(0,{})}")
+    legacy = aot.StepExecutableCache.for_config(_cfg(), total_steps=4,
+                                                cache_dir=cache)
+    assert legacy.load("step", key) is not None
+    assert legacy.failures == 0

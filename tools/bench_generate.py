@@ -47,6 +47,7 @@ def main(argv=None) -> int:
 
     from distributeddeeplearning_tpu.models import model_spec
     from distributeddeeplearning_tpu.models.generate import generate
+    from distributeddeeplearning_tpu.observability import perf_report
 
     total = args.prompt_len + args.new_tokens
     spec = model_spec(args.model)
@@ -72,7 +73,7 @@ def main(argv=None) -> int:
                        max_new_tokens=args.new_tokens, use_cache=use_cache)
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
-        print(json.dumps({
+        print(json.dumps(perf_report.annotate({
             "metric": f"{args.model}_decode_tokens_per_sec",
             "mode": "kv_cache" if use_cache else "full_refeed",
             "value": round(args.batch * args.new_tokens / dt, 1),
@@ -80,7 +81,7 @@ def main(argv=None) -> int:
             "batch": args.batch, "prompt_len": args.prompt_len,
             "new_tokens": args.new_tokens,
             "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
-        }), flush=True)
+        }, provenance="fresh")), flush=True)
 
     timed(True)
     if not args.skip_refeed:
@@ -102,14 +103,14 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         jax.block_until_ready(spec())
         dt = time.perf_counter() - t0
-        print(json.dumps({
+        print(json.dumps(perf_report.annotate({
             "metric": f"{args.model}_decode_tokens_per_sec",
             "mode": f"speculative_selfdraft_k{args.draft_len}",
             "value": round(args.new_tokens / dt, 1),
             "unit": "tokens/sec", "batch": 1,
             "prompt_len": args.prompt_len, "new_tokens": args.new_tokens,
             "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
-        }), flush=True)
+        }, provenance="fresh")), flush=True)
     return 0
 
 

@@ -84,6 +84,22 @@ if [ "$PREFLIGHT_RC" -ne 0 ]; then
   exit 0
 fi
 
+# 0b. Static distributed-correctness analyzer (gated, ask with DDL_LINT=1;
+# CPU-only, ~5 s, runs BEFORE the benches on purpose): a full ddl_lint run
+# records the collective-schedule fingerprints in the last_ddl_lint
+# sidecar, and every bench record this window emits then carries
+# collective_schedules via perf_report.annotate — the throughput numbers
+# name the exact collective schedule they were measured under
+# (docs/static_analysis.md). Findings do NOT abort the window: the
+# artifact lands in $RES/ddl_lint.json and the rc lands in timings.jsonl
+# for the driver to gate on.
+if [ "${DDL_LINT:-0}" = "1" ]; then
+  check_stop ddl_lint
+  timeout 180 env JAX_PLATFORMS=cpu python tools/ddl_lint.py --json \
+    > "$RES/ddl_lint.json" 2>> "$RES/log.txt"
+  note ddl_lint
+fi
+
 # --- Priority prefix: fits a ~25-min window -------------------------------
 
 # 1. Headline bench, quick protocol first (P50 ~3 min warm-cache; the

@@ -290,6 +290,37 @@ def check_flight() -> None:
         emit("flight_record", ok=True, error=str(e)[:200])
 
 
+def check_ddl_lint() -> None:
+    """Static distributed-correctness state (tools/ddl_lint.py): the two
+    jax-free AST passes run LIVE (they are fast), plus the recorded
+    last_ddl_lint sidecar for the tracing pass's verdict and schedule
+    fingerprints. ok=False only on live findings or a recorded failing
+    run — an absent sidecar just means ddl_lint has not run yet."""
+    try:
+        from distributeddeeplearning_tpu.analysis import (donation, lints,
+                                                          repo_root)
+        from distributeddeeplearning_tpu.observability import sidecars
+        roots = [os.path.join(repo_root(), r)
+                 for r in ("distributeddeeplearning_tpu", "tools",
+                           "train.py", "bench.py", "generate.py",
+                           "launch.py")]
+        live = lints.analyze_paths(roots) + donation.analyze_paths(roots)
+        side = sidecars.read("last_ddl_lint")
+        age = sidecars.age_s(side)
+        recorded_ok = side.get("ok") if side else None
+        emit("ddl_lint", ok=not live and recorded_ok is not False,
+             live_findings=len(live),
+             live_detail=[f"{f.get('file')}:{f.get('line')} {f['rule']}"
+                          for f in live[:5]],
+             last_run_ok=recorded_ok,
+             last_run_age_s=round(age, 1) if age is not None else None,
+             schedules=(side or {}).get("collective_schedules"),
+             note=(None if side else "no last_ddl_lint sidecar; run "
+                   "python tools/ddl_lint.py"))
+    except Exception as e:
+        emit("ddl_lint", ok=True, error=str(e)[:200])
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-timeout", type=int, default=45)
@@ -308,6 +339,7 @@ def main(argv=None) -> int:
     check_sharding()
     check_elastic()
     check_flight()
+    check_ddl_lint()
     return 0
 
 

@@ -22,6 +22,7 @@ marked slow are never violations regardless of duration.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 DEFAULT_THRESHOLD_S = 60.0
@@ -131,20 +132,59 @@ def audit_flight(records) -> list[str]:
     return problems
 
 
+def audit_lint(records) -> list[str]:
+    """Problems with ddl-lint gate coverage in this run.
+
+    The static-analysis gate (tests marked ``lint``) has the same
+    silent-disarm failure modes: the marked tests vanish from the
+    selection, every one is also marked ``slow`` and tier-1's
+    ``-m 'not slow'`` filters the gate out, or the marker itself was
+    dropped from pytest.ini and pytest's strict-marker path stops
+    recognizing it."""
+    problems = []
+    lint = [r for r in records if r.get("lint")]
+    if not lint:
+        problems.append(
+            "no lint-marked test ran — the ddl-lint static-analysis gate "
+            "is untested in this run (tests/test_ddl_lint.py missing, "
+            "renamed, or deselected?)")
+    elif all(r.get("slow") for r in lint):
+        problems.append(
+            "every lint-marked test is also marked slow — tier-1 runs "
+            "-m 'not slow', so the static-analysis gate is silently "
+            "disarmed in tier-1 (lint tests are fast; never mark them "
+            "slow)")
+    ini = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "pytest.ini")
+    try:
+        with open(ini, encoding="utf-8") as f:
+            registered = any(line.strip().startswith("lint:")
+                             for line in f)
+    except OSError:
+        registered = False
+    if not registered:
+        problems.append(
+            "the 'lint' marker is not registered in pytest.ini — "
+            "register it under [pytest] markers or the gate tests "
+            "become warnings instead of a gate")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
               f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
-              f"[--expect-elastic] [--expect-flight]")
+              f"[--expect-elastic] [--expect-flight] [--expect-lint]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
     expect_elastic = "--expect-elastic" in argv
     expect_flight = "--expect-flight" in argv
+    expect_lint = "--expect-lint" in argv
     argv = [a for a in argv
             if a not in ("--expect-perf-gate", "--expect-elastic",
-                         "--expect-flight")]
+                         "--expect-flight", "--expect-lint")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -169,6 +209,9 @@ def main(argv=None) -> int:
     # Flight-record coverage likewise (both problems are presence checks).
     if expect_flight:
         gate_problems += audit_flight(records)
+    # ddl-lint gate coverage likewise (presence + registration checks).
+    if expect_lint:
+        gate_problems += audit_lint(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

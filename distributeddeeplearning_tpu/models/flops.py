@@ -47,14 +47,40 @@ _BF16_PEAK_BY_KIND = (
     ("v2", 46e12),
 )
 
+# HBM bandwidth bytes/s per chip, same key scheme. Decode at small batch
+# is memory-bound (every token re-reads the weights and the KV cache), so
+# this is the roof serving numbers are scored against. Sources: published
+# TPU spec sheets (v5e 819 GB/s, v5p 2765, v4 1228, v3 900, v2 700,
+# Trillium ~1640).
+_HBM_BW_BY_KIND = (
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v6 lite", 1640e9),
+    ("v6e", 1640e9),
+    ("trillium", 1640e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def _by_kind(table, device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, value in table:
+        if sub in kind:
+            return value
+    return None
+
 
 def bf16_peak_flops(device_kind: str) -> float | None:
     """Per-chip bf16 peak for a jax device_kind, or None if unknown."""
-    kind = device_kind.lower()
-    for sub, peak in _BF16_PEAK_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
+    return _by_kind(_BF16_PEAK_BY_KIND, device_kind)
+
+
+def hbm_bw_bytes(device_kind: str) -> float | None:
+    """Per-chip HBM bandwidth (bytes/s), or None if unknown."""
+    return _by_kind(_HBM_BW_BY_KIND, device_kind)
 
 
 def _transformer_fwd_flops(*, num_layers: int, hidden: int, ffn: int,
@@ -89,6 +115,20 @@ def _transformer_fwd_flops(*, num_layers: int, hidden: int, ffn: int,
     return num_layers * per_layer + head + embed
 
 
+# Causal-LM geometries, shared by the training-FLOPs path (below) and the
+# decode FLOPs/bytes model: one source of truth so a serving roofline and
+# a training MFU for the same model can never disagree on shapes. Tiny
+# test models are deliberately absent, like everywhere else in this file.
+_CAUSAL_GEOM = {
+    "gpt2_small": dict(num_layers=12, hidden=768, ffn=3072, vocab=50257),
+    "gpt2_medium": dict(num_layers=24, hidden=1024, ffn=4096, vocab=50257),
+    "llama2_7b": dict(num_layers=32, hidden=4096, ffn=11008, vocab=32000,
+                      ffn_matmuls=3),
+    "tinyllama_1b": dict(num_layers=22, hidden=2048, ffn=5632, vocab=32000,
+                         ffn_matmuls=3, kv_heads_frac=4 / 32),
+}
+
+
 def fwd_flops_per_example(model: str, *, seq_len: int | None = None,
                           mlm_positions: int = 0) -> float | None:
     """Analytic forward FLOPs for one example, or None if the model has no
@@ -112,21 +152,10 @@ def fwd_flops_per_example(model: str, *, seq_len: int | None = None,
             num_layers=24 if large else 12, hidden=1024 if large else 768,
             ffn=4096 if large else 3072, seq_len=seq_len, vocab=30522,
             head_positions=mlm_positions or seq_len, mlm_transform=True)
-    if model in ("gpt2_small", "gpt2_medium"):
-        med = model == "gpt2_medium"
-        return _transformer_fwd_flops(
-            num_layers=24 if med else 12, hidden=1024 if med else 768,
-            ffn=4096 if med else 3072, seq_len=seq_len, vocab=50257,
-            head_positions=seq_len)
-    if model == "llama2_7b":
-        return _transformer_fwd_flops(
-            num_layers=32, hidden=4096, ffn=11008, seq_len=seq_len,
-            vocab=32000, head_positions=seq_len, ffn_matmuls=3)
-    if model == "tinyllama_1b":
-        return _transformer_fwd_flops(
-            num_layers=22, hidden=2048, ffn=5632, seq_len=seq_len,
-            vocab=32000, head_positions=seq_len, ffn_matmuls=3,
-            kv_heads_frac=4 / 32)
+    geom = _CAUSAL_GEOM.get(model)
+    if geom is not None:
+        return _transformer_fwd_flops(seq_len=seq_len,
+                                      head_positions=seq_len, **geom)
     return None
 
 
@@ -136,3 +165,93 @@ def train_flops_per_example(model: str, *, seq_len: int | None = None,
     fwd = fwd_flops_per_example(model, seq_len=seq_len,
                                 mlm_positions=mlm_positions)
     return None if fwd is None else 3.0 * fwd
+
+
+def decode_flops_per_token(model: str, *,
+                           context_len: int) -> float | None:
+    """Model FLOPs to emit ONE token at batch 1 with a KV cache holding
+    ``context_len`` positions: every weight matmul at seq=1 plus the two
+    attention products against the cached context. None for models with
+    no causal geometry entry."""
+    g = _CAUSAL_GEOM.get(model)
+    if g is None:
+        return None
+    d, ffn = g["hidden"], g["ffn"]
+    kv = g.get("kv_heads_frac", 1.0)
+    per_layer = (
+        2 * d * d                      # Q proj
+        + 2 * 2 * d * (d * kv)         # K and V proj
+        + 2 * context_len * d          # q @ K^T over the cache (all heads)
+        + 2 * context_len * d          # probs @ V
+        + 2 * d * d                    # output proj
+        + g.get("ffn_matmuls", 2) * 2 * d * ffn)
+    return g["num_layers"] * per_layer + 2 * d * g["vocab"]
+
+
+def _decode_weight_and_kv_bytes(model: str, *, context_len: int,
+                                dtype_bytes: int = 2):
+    """(weight_bytes, kv_bytes) per decode step row: the full weight set
+    and one row's KV-cache read (+ its one-position write). Split out
+    because batching amortizes the first and multiplies the second."""
+    g = _CAUSAL_GEOM.get(model)
+    if g is None:
+        return None
+    d, ffn = g["hidden"], g["ffn"]
+    kv = g.get("kv_heads_frac", 1.0)
+    weight_params = g["num_layers"] * (
+        d * d * 2                      # Q + output proj
+        + 2 * d * (d * kv)             # K and V proj
+        + g.get("ffn_matmuls", 2) * d * ffn) + d * g["vocab"]  # LM head
+    kv_traffic = g["num_layers"] * 2 * (context_len + 1) * (d * kv)
+    return (weight_params * float(dtype_bytes),
+            kv_traffic * float(dtype_bytes))
+
+
+def decode_bytes_per_token(model: str, *, context_len: int,
+                           dtype_bytes: int = 2) -> float | None:
+    """HBM bytes moved to emit ONE token at batch 1: the full weight set
+    (read once per token — nothing amortizes it at batch 1) plus the KV
+    cache read (2 x context x kv-width per layer) and the one-position
+    write. This is why small-batch decode is memory-bound: FLOPs shrink
+    with seq=1 but the weight traffic does not."""
+    traffic = _decode_weight_and_kv_bytes(model, context_len=context_len,
+                                          dtype_bytes=dtype_bytes)
+    return None if traffic is None else traffic[0] + traffic[1]
+
+
+def decode_roofline(model: str, *, context_len: int,
+                    tokens_per_sec: float | None,
+                    device_kind: str | None,
+                    dtype_bytes: int = 2, batch: int = 1) -> dict:
+    """Roofline fields for a decode token rate (tokens/sec/chip).
+
+    Per decode step at batch B the chip moves ``weights + B x kv`` bytes
+    and does ``B x flops_per_token`` FLOPs, so the attainable rate is
+    ``B / max(B*flops/peak, (weights + B*kv)/bw)`` — at batch 1 the
+    weight traffic dominates (``bound == "memory"``), and growing B
+    amortizes exactly that term, which is the whole motivation for
+    continuous batching. Unknown model/chip omits the respective fields;
+    never raises."""
+    flops = decode_flops_per_token(model, context_len=context_len)
+    traffic = _decode_weight_and_kv_bytes(model, context_len=context_len,
+                                          dtype_bytes=dtype_bytes)
+    if flops is None or traffic is None or tokens_per_sec is None:
+        return {}
+    weight_bytes, kv_bytes = traffic
+    batch = max(1, int(batch))
+    out = {"decode_flops_per_token": flops,
+           "decode_bytes_per_token": weight_bytes + kv_bytes,
+           "context_len": int(context_len), "batch": batch,
+           "gflops_per_sec": round(tokens_per_sec * flops / 1e9, 2)}
+    if not device_kind:
+        return out
+    peak, bw = bf16_peak_flops(device_kind), hbm_bw_bytes(device_kind)
+    if not peak or not bw:
+        return out
+    compute_s = batch * flops / peak
+    memory_s = (weight_bytes + batch * kv_bytes) / bw
+    out["bound"] = "memory" if memory_s >= compute_s else "compute"
+    attainable = batch / max(compute_s, memory_s)
+    out["attainable_tokens_per_sec"] = round(attainable, 1)
+    out["pct_of_peak"] = round(100.0 * tokens_per_sec / attainable, 1)
+    return out

@@ -27,7 +27,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _require_decode(model, total: int) -> None:
+def decode_capacity(model) -> Optional[int]:
+    """The model's static decode position/cache bound: ``max_position``
+    (GPT — the learned position table is the binding constraint) or
+    ``decode_cache_len`` (Llama — RoPE has no table, only the KV buffer).
+    None for models without either (no decode mode, or unbounded)."""
+    mcfg = getattr(model, "cfg", None)
+    return (getattr(mcfg, "max_position", None)
+            or getattr(mcfg, "decode_cache_len", None))
+
+
+def _require_decode(model, total: int, *, request_totals=None) -> None:
     """Shared use_cache preconditions for the sampling and beam paths.
 
     The models validate only the PREFILL block length themselves; the
@@ -35,6 +45,11 @@ def _require_decode(model, total: int) -> None:
     (clamped by dynamic_update_slice — silently degenerate), so the full
     prompt+new budget is checked here, against ``max_position`` (GPT) or
     ``decode_cache_len`` (Llama — size it to prompt+new, as the CLI does).
+
+    ``request_totals``: optional per-request (prompt + max_new) budgets for
+    ragged callers (the serve engine's admission path) — the error then
+    names WHICH request overflows and by how much, not just the batch
+    aggregate, so the engine can reject exactly the offending request.
     """
     import inspect
 
@@ -43,10 +58,21 @@ def _require_decode(model, total: int) -> None:
             f"use_cache=True needs a model with a decode (KV-cache) mode — "
             f"the GPT/Llama families; {type(model).__name__} has none. "
             f"Use the default full-refeed path.")
-    mcfg = getattr(model, "cfg", None)
-    max_pos = (getattr(mcfg, "max_position", None)
-               or getattr(mcfg, "decode_cache_len", None))
-    if max_pos is not None and total > max_pos:
+    max_pos = decode_capacity(model)
+    if max_pos is None:
+        return
+    if request_totals is not None:
+        over = [(i, int(t)) for i, t in enumerate(request_totals)
+                if t > max_pos]
+        if over:
+            i, t = over[0]
+            raise ValueError(
+                f"request {i} needs cache/position capacity {t} (prompt + "
+                f"max_new_tokens) but the model's "
+                f"max_position/decode_cache_len is {max_pos} — over by "
+                f"{t - max_pos} tokens ({len(over)} of "
+                f"{len(list(request_totals))} requests overflow)")
+    if total > max_pos:
         raise ValueError(
             f"this decode needs cache/position capacity {total} (prompt + "
             f"max_new_tokens, plus draft_len slack on the speculative "
@@ -209,24 +235,50 @@ def generate_beam(model, variables, prompt_ids, *, max_new_tokens: int,
     return jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
 
 
+# Every decode-cache leaf name, classified by what its leading dim means.
+# Consumers (beam reorder below, serve/kv_cache.py's prefill packing) look
+# leaves up HERE instead of guessing from shapes, so a new cache entry must
+# be taught once, explicitly (ADVICE r3 #3):
+#
+# - "batched": per-request rows (cached_key/cached_value — dense K/V);
+# - "scalar":  shared write indices (cache_index, GPT's position counter);
+# - "pool":    slot-shared paged K/V pools (serve/kv_cache.py) — leading
+#   dim is PAGES, not requests, so beam expansion/reorder is meaningless.
+CACHE_LEAF_KINDS = {
+    "cached_key": "batched",
+    "cached_value": "batched",
+    "cache_index": "scalar",
+    "position": "scalar",
+    "pages_k": "pool",
+    "pages_v": "pool",
+}
+
+
 def _map_batched_cache(cache, fn):
     """Apply ``fn`` to the batched K/V cache leaves (``cached_key`` /
     ``cached_value``), leave the per-layer scalar write indices alone, and
-    REJECT any leaf name this function has never been taught — a new cache
-    entry must be classified here explicitly, not silently guessed from its
-    leading-dim size (ADVICE r3 #3)."""
+    REJECT any leaf name :data:`CACHE_LEAF_KINDS` has never been taught —
+    a new cache entry must be classified there explicitly, not silently
+    guessed from its leading-dim size (ADVICE r3 #3)."""
     from flax import traverse_util
 
     flat = traverse_util.flatten_dict(cache)
     for path, x in flat.items():
-        if path[-1] in ("cached_key", "cached_value"):
+        kind = CACHE_LEAF_KINDS.get(path[-1])
+        if kind == "batched":
             flat[path] = fn(x)
-        elif path[-1] not in ("cache_index", "position"):
+        elif kind == "pool":
+            raise ValueError(
+                f"paged-pool cache leaf {'/'.join(map(str, path))} in a "
+                f"beam context: pool rows are pages shared across slots, "
+                f"not per-request rows — beam search needs the dense "
+                f"decode cache (drop paged_state)")
+        elif kind != "scalar":
             raise ValueError(
                 f"unknown decode-cache leaf {'/'.join(map(str, path))}: "
                 f"beam search must know whether to expand/reorder it "
                 f"(batched, like cached_key) or share it (scalar, like "
-                f"cache_index) — add it to _map_batched_cache")
+                f"cache_index) — add it to CACHE_LEAF_KINDS")
     return traverse_util.unflatten_dict(flat)
 
 

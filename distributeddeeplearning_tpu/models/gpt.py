@@ -65,7 +65,7 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False):
+                 decode: bool = False, paged_state=None):
         cfg = self.cfg
         b, s, _ = x.shape
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -76,7 +76,21 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
 
-        if decode:
+        if decode and paged_state is not None:
+            # Paged decode (serve/kv_cache.py): rows are decode SLOTS, each
+            # at its own position paged_state.lengths[i], K/V scattered
+            # into pool pages instead of a per-request dense cache. The
+            # pools are engine-seeded cache leaves — same softmax/mask
+            # numerics as the dense branch below (token-identity pinned by
+            # tests/test_serve.py).
+            from distributeddeeplearning_tpu.serve import kv_cache as paged
+            pk = self.variable("cache", "pages_k",
+                               paged.unseeded_pool("pages_k"))
+            pv = self.variable("cache", "pages_v",
+                               paged.unseeded_pool("pages_v"))
+            out, pk.value, pv.value = paged.paged_attention_step(
+                q, k, v, pk.value, pv.value, paged_state)
+        elif decode:
             # Incremental decoding: a block of s tokens (s = prompt length
             # on the prefill call, 1 per step after) is appended to a
             # (B, max_position, H, D) cache and attends over the live
@@ -130,12 +144,13 @@ class DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False):
+                 decode: bool = False, paged_state=None):
         cfg = self.cfg
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln1")(x)
         h = CausalSelfAttention(cfg, self.dtype, name="attention")(
-            h, pad_mask, deterministic=deterministic, decode=decode)
+            h, pad_mask, deterministic=deterministic, decode=decode,
+            paged_state=paged_state)
         x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
                          param_dtype=jnp.float32, name="ln2")(x)
@@ -156,10 +171,20 @@ class GptLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *,
-                 train: bool = True, decode: bool = False):
+                 train: bool = True, decode: bool = False,
+                 paged_state=None):
         cfg = self.cfg
         deterministic = not train
         b, s = input_ids.shape
+        if paged_state is not None and not decode:
+            raise ValueError("paged_state is a decode-mode construct; "
+                             "call with decode=True")
+        if paged_state is not None and s != 1:
+            raise ValueError(
+                f"paged decode advances exactly one token per slot per "
+                f"step (got a block of {s}); prompts prefill through the "
+                f"dense decode path and are packed into pages "
+                f"(serve/kv_cache.pack_prefill_cache)")
         if decode and cfg.pipeline_stages > 1:
             raise ValueError("decode (KV-cache) mode is not supported for "
                              "pipelined models; generate with the "
@@ -194,7 +219,13 @@ class GptLM(nn.Module):
                 perm, inv = zigzag_indices(s, n_seq)
                 input_ids = input_ids[:, perm]
                 pad_mask = pad_mask[:, perm]
-        if decode:
+        if decode and paged_state is not None:
+            # Paged decode: every slot sits at its OWN position (the
+            # engine's per-slot lengths), so the shared scalar counter the
+            # dense branch keeps cannot exist — positions come from the
+            # state, shaped (B, 1) for a per-row wpe lookup.
+            pos_index = paged_state.lengths[:, None]
+        elif decode:
             # Positions continue from the decode counter (a top-level cache
             # variable advanced by the block length; per-attention cache
             # indices advance in lockstep) — s = prompt length on prefill,
@@ -216,9 +247,13 @@ class GptLM(nn.Module):
                                                 (None, "embed")),
             (cfg.max_position, cfg.hidden_size), jnp.float32)
         # embedding_lookup: fsdp-friendly scatter-add backward
-        # (ops/embedding.py; VERDICT r4 Missing #5).
+        # (ops/embedding.py; VERDICT r4 Missing #5). Shared 1D positions
+        # broadcast over the batch; paged per-row (B, 1) positions already
+        # carry the batch dim.
+        pos_emb = embedding_lookup(wpe, pos_index)
         x = (embedding_lookup(wte, input_ids)
-             + embedding_lookup(wpe, pos_index)[None]).astype(self.dtype)
+             + (pos_emb if pos_emb.ndim == 3 else pos_emb[None])
+             ).astype(self.dtype)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
@@ -244,7 +279,7 @@ class GptLM(nn.Module):
                         block, x, pad_mask)
                 else:
                     x = block(x, pad_mask, deterministic=deterministic,
-                              decode=decode)
+                              decode=decode, paged_state=paged_state)
                 x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if inv is not None:

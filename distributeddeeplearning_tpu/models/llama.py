@@ -64,17 +64,21 @@ def apply_rope(x, *, theta: float, offset=0, positions=None):
     """Rotary embedding, half-split (rotate_half) convention: x (B, S, H, D)
     rotated by (offset + index) along dim 1 — ``offset`` (may be traced)
     positions a decode-mode single token at its absolute index, while
-    ``positions`` (an (S,) int array) overrides the arange entirely for
-    layouts where slot != absolute position (the zigzag permutation). f32
-    rotation regardless of storage dtype (sin/cos in bf16 visibly degrades
+    ``positions`` overrides the arange entirely for layouts where slot !=
+    absolute position: an (S,) int array shared across the batch (the
+    zigzag permutation) or a (B, S) array when every row sits at its own
+    position (paged decode — each serve slot's length). f32 rotation
+    regardless of storage dtype (sin/cos in bf16 visibly degrades
     long-range phase)."""
     b, s, h, d = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     pos = (jnp.asarray(positions, jnp.float32) if positions is not None
            else offset + jnp.arange(s, dtype=jnp.float32))
-    ang = pos[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos[..., None] * freqs              # (S, d/2) or (B, S, d/2)
+    if ang.ndim == 2:
+        ang = ang[None]                       # shared across the batch
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
@@ -87,7 +91,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False, positions=None):
+                 decode: bool = False, positions=None, paged_state=None):
         cfg = self.cfg
         b, s, _ = x.shape
         d = cfg.head_dim
@@ -97,6 +101,8 @@ class LlamaAttention(nn.Module):
                    self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
         v = _dense(cfg.num_kv_heads * d, ("embed", "heads"), "v_proj",
                    self.dtype)(x).reshape(b, s, cfg.num_kv_heads, d)
+        if decode and paged_state is not None:
+            return self._paged_decode_step(q, k, v, paged_state)
         if decode:
             return self._decode_step(q, k, v)
         # ``positions`` carries the zigzag permutation: in that layout slot
@@ -126,6 +132,26 @@ class LlamaAttention(nn.Module):
                          if not deterministic and cfg.dropout_rate > 0
                          else None),
             deterministic=deterministic)
+        return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      self.dtype)(out)
+
+    def _paged_decode_step(self, q, k, v, paged_state):
+        """Paged decode (serve/kv_cache.py): rows are serve SLOTS, each at
+        its own absolute position ``paged_state.lengths[i]`` — RoPE rotates
+        per row ((B, 1) positions) before the pool write, same
+        absolute-position-before-caching convention as the dense branch.
+        Pools are engine-seeded cache leaves at kv-head width."""
+        from distributeddeeplearning_tpu.serve import kv_cache as paged
+        cfg = self.cfg
+        pos = paged_state.lengths[:, None]                   # (B, 1)
+        q = apply_rope(q, theta=cfg.rope_theta, positions=pos)
+        k = apply_rope(k, theta=cfg.rope_theta, positions=pos)
+        pk = self.variable("cache", "pages_k",
+                           paged.unseeded_pool("pages_k"))
+        pv = self.variable("cache", "pages_v",
+                           paged.unseeded_pool("pages_v"))
+        out, pk.value, pv.value = paged.paged_attention_step(
+            q, k, v, pk.value, pv.value, paged_state)
         return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
                       self.dtype)(out)
 
@@ -180,12 +206,12 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, *, deterministic: bool,
-                 decode: bool = False, positions=None):
+                 decode: bool = False, positions=None, paged_state=None):
         cfg = self.cfg
         h = _rms_norm(cfg, self.dtype, "attention_norm")(x)
         h = LlamaAttention(cfg, self.dtype, name="attention")(
             h, pad_mask, deterministic=deterministic, decode=decode,
-            positions=positions)
+            positions=positions, paged_state=paged_state)
         x = x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = _rms_norm(cfg, self.dtype, "mlp_norm")(x)
         gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
@@ -206,10 +232,20 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *,
-                 train: bool = True, decode: bool = False):
+                 train: bool = True, decode: bool = False,
+                 paged_state=None):
         cfg = self.cfg
         deterministic = not train
         b, s = input_ids.shape
+        if paged_state is not None and not decode:
+            raise ValueError("paged_state is a decode-mode construct; "
+                             "call with decode=True")
+        if paged_state is not None and s != 1:
+            raise ValueError(
+                f"paged decode advances exactly one token per slot per "
+                f"step (got a block of {s}); prompts prefill through the "
+                f"dense decode path and are packed into pages "
+                f"(serve/kv_cache.pack_prefill_cache)")
         pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
                     else attention_mask.astype(jnp.bool_))
 
@@ -256,7 +292,8 @@ class LlamaLM(nn.Module):
                     block, x, pad_mask, positions)
             else:
                 x = block(x, pad_mask, deterministic=deterministic,
-                          decode=decode, positions=positions)
+                          decode=decode, positions=positions,
+                          paged_state=paged_state)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         if inv is not None:

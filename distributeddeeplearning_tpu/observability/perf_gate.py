@@ -70,10 +70,31 @@ WORKLOAD = {
 # retrace or added sync in the sharded schedule fails tier-1 here instead
 # of waiting for chip time. Fewer steps than the default: the sharded
 # step is slower per step and the gate needs a median, not a mean.
+# The serve-engine decode proxy (kind="serve_decode" routes construction
+# to :class:`ServeProxyRunner`): a tiny Engine with every slot held live,
+# so each timed step is one compiled decode advance plus the engine's
+# host bookkeeping — the per-token serving cost continuous batching pays.
+# A regression here (retrace in the decode program, accidental pool copy,
+# host loop bloat) fails tier-1 instead of waiting for chip time.
+SERVE_WORKLOAD = {
+    "kind": "serve_decode",
+    "model": "gpt_tiny",
+    "vocab_size": 256,
+    "dtype": "float32",
+    "max_slots": 4,
+    "page_size": 4,
+    "num_pages": 32,
+    "max_pages_per_slot": 8,
+    "prefill_buckets": [8],
+    "seed": 0,
+    "steps": 10,
+    "warmup": 3,
+}
 WORKLOADS = {
     "default": WORKLOAD,
     "zero2_overlap": dict(WORKLOAD, steps=6, dp=2,
                           optimizer_sharding="zero2"),
+    "serve_decode": SERVE_WORKLOAD,
 }
 # LR-schedule horizon compiled into the step program; fixed so every
 # measure() pass (and the AOT cache) shares one executable.
@@ -206,6 +227,94 @@ class ProxyRunner:
         }
 
 
+class ServeProxyRunner:
+    """Decode-capacity proxy for serve/engine.py. Builds ONE tiny Engine
+    (compile-cache off — the gate times the build in front of it, never a
+    deserialized one) and, per measurement pass, fills every slot with a
+    request long enough to stay live through the timed window: each timed
+    ``Engine.step()`` is then exactly one static-shape decode advance.
+    Same result schema as :class:`ProxyRunner`, so :func:`compare` and the
+    baseline file work unchanged."""
+
+    def __init__(self, workload: Optional[dict] = None):
+        self.workload = dict(SERVE_WORKLOAD, **(workload or {}))
+        from distributeddeeplearning_tpu.serve.engine import (Engine,
+                                                              ServeConfig)
+
+        w = self.workload
+        self.config = ServeConfig(
+            model=w["model"], vocab_size=w["vocab_size"], dtype=w["dtype"],
+            max_slots=w["max_slots"], page_size=w["page_size"],
+            num_pages=w["num_pages"],
+            max_pages_per_slot=w["max_pages_per_slot"],
+            prefill_buckets=tuple(w["prefill_buckets"]), seed=w["seed"],
+            compile_cache_dir="off")
+        self.engine = Engine(self.config)
+        self.engine.warmup()
+
+    def measure(self, *, steps: Optional[int] = None,
+                warmup: Optional[int] = None,
+                inject_sleep_s: float = 0.0) -> dict:
+        w = self.workload
+        steps = w["steps"] if steps is None else steps
+        warmup = w["warmup"] if warmup is None else warmup
+        eng = self.engine
+        if not eng.idle:  # leftovers from a previous pass
+            eng.run_until_idle()
+        # One request per slot, sized to outlive warmup + timed steps
+        # (admission prefill emits token 1; each step emits one more).
+        prompt_len = min(4, max(self.config.prefill_buckets))
+        max_new = warmup + steps + 1
+        if prompt_len + max_new > self.config.slot_capacity:
+            raise ValueError(
+                f"serve_decode workload needs {prompt_len + max_new} "
+                f"tokens/slot but slot capacity is "
+                f"{self.config.slot_capacity}; shrink steps or grow pages")
+        for s in range(self.config.max_slots):
+            eng.submit([1 + s] * prompt_len, max_new_tokens=max_new)
+        for _ in range(warmup):
+            eng.step()
+        assert eng.num_live == self.config.max_slots
+        tele = telemetry.Telemetry(enabled=True)
+        per_step: list[float] = []
+        for k in range(steps):
+            t0 = telemetry.now_s()
+            with tele.span("host_stall", step=k):
+                if inject_sleep_s > 0:
+                    time.sleep(inject_sleep_s)
+            with tele.span("decode_step", step=k):
+                eng.step()  # np.asarray on the emitted tokens is the sync
+            per_step.append(telemetry.now_s() - t0)
+        eng.run_until_idle()
+        phases = telemetry.phase_totals(tele.snapshot())
+        span_total = sum(p["total_ms"] for p in phases.values()) or 1.0
+        calib = calibrate()
+        step_s = statistics.median(per_step)
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "workload": dict(self.workload,
+                             **({"steps": steps, "warmup": warmup})),
+            "step_time_ms": round(step_s * 1e3, 3),
+            "calib_unit_ms": round(calib * 1e3, 3),
+            "normalized_step": round(step_s / calib, 4),
+            "phase_share": {name: round(p["total_ms"] / span_total, 4)
+                            for name, p in phases.items()},
+            "phases": phases,
+            "injected_sleep_s": inject_sleep_s,
+        }
+
+
+def runner_for(workload: str = "default"):
+    """The right proxy runner for a named gate workload: training loop by
+    default, the serve engine for kind="serve_decode" entries."""
+    if workload == "default":
+        return ProxyRunner()
+    w = WORKLOADS[workload]
+    if w.get("kind") == "serve_decode":
+        return ServeProxyRunner(w)
+    return ProxyRunner(w)
+
+
 def measure(runner: Optional[ProxyRunner] = None, **kw) -> dict:
     return (runner or ProxyRunner()).measure(**kw)
 
@@ -286,8 +395,7 @@ def check(baseline_path: Optional[str] = None,
     tools/doctor.py (extras never overwrite the headline sidecar)."""
     baseline = load_baseline(baseline_path, name=workload)
     if runner is None:
-        runner = ProxyRunner(None if workload == "default"
-                             else WORKLOADS[workload])
+        runner = runner_for(workload)
     current = measure(runner, inject_sleep_s=inject_sleep_s)
     violations = compare(baseline, current)
     result: dict[str, Any] = {
@@ -318,8 +426,7 @@ def recalibrate(path: Optional[str] = None,
     baseline file. Recalibrating "default" rewrites the top level but
     PRESERVES any ``extras`` entries; recalibrating a named extra rewrites
     only its entry under ``extras``. Returns the baseline entry written."""
-    r = runner or ProxyRunner(None if workload == "default"
-                              else WORKLOADS[workload])
+    r = runner or runner_for(workload)
     best = None
     for _ in range(max(passes, 1)):
         cur = r.measure()

@@ -26,6 +26,8 @@ point is a shared shape, not a gatekeeper):
                            fingerprints
     schedule_fingerprints  analysis/collectives.py — config-fp -> schedule-fp
                            pairing registry for the AOT cache cross-check
+    last_serve             tools/bench_serve.py — last continuous-batching
+                           serve bench record (doctor.py serve report)
 
 Pure stdlib; safe to import from jax-free tools.
 """
@@ -39,7 +41,8 @@ from typing import Any, Optional
 SCHEMA_VERSION = 1
 
 KNOWN = ("last_run_sharding", "last_elastic_event", "last_bench",
-         "perf_gate_last", "last_ddl_lint", "schedule_fingerprints")
+         "perf_gate_last", "last_ddl_lint", "schedule_fingerprints",
+         "last_serve")
 
 
 def cache_dir() -> str:

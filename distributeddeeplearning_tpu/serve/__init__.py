@@ -1,0 +1,12 @@
+"""Continuous-batching serving substrate (ISSUE 12 / ROADMAP item 1).
+
+- :mod:`serve.kv_cache`   — paged/ragged KV cache: fixed-size pages from a
+  preallocated HBM pool, per-slot page tables, free-list reuse;
+- :mod:`serve.engine`     — prefill/decode-split generation engine that
+  admits and retires decode slots every step;
+- :mod:`serve.scheduler`  — per-tenant SLO-aware admission / preemption.
+
+Imports are deliberately lazy (no submodule import here): models import
+``serve.kv_cache`` from inside their decode branches, and an eager package
+import would cycle back through ``models``.
+"""

@@ -1,0 +1,512 @@
+"""Continuous-batching generation engine: prefill/decode split over a
+paged KV cache, slots admitted and retired every step.
+
+Why not ``models/generate.py`` for serving: ``generate()`` runs one batch
+shape to completion — chips idle whenever sequences finish early, and a
+long prompt stalls every other request in the batch. This engine runs two
+separately compiled programs instead (the same per-program decomposition
+PAPERS.md motivates for MPMD pipeline training, applied to inference):
+
+- **prefill** (one program per prompt-length bucket): a batch-1 dense
+  decode forward over the right-padded prompt, whose K/V is packed into
+  pool pages *inside the same program* (``kv_cache.pack_prefill_cache``
+  with the real length as a traced scalar — one compile per bucket, any
+  prompt length within it), returning the first generated token;
+- **decode** (one static-shape program): every live slot advances exactly
+  one token per call via the models' ``paged_state`` branch. Slots join
+  and leave between calls by flipping rows of the page table / lengths /
+  live mask — the compiled program never changes shape.
+
+Both programs are lowered through ``perf/aot.py``'s executable cache
+under a serve-specific config fingerprint, so a warm replica boots with
+zero retraces (``Engine.warmup()`` + ``aot_stats()``).
+
+Greedy (temperature=0) only in v1: preemption re-queues a request with
+its generated prefix folded into the prompt, and greedy decoding is what
+makes that continuation deterministic (tests pin token-identity against
+sequential ``generate(use_cache=True)``, including across preemption and
+mid-stream retire/admit). Sampled serving needs per-slot RNG lanes —
+deliberately out of scope here.
+
+Observability: per-request lifecycle events (``serve_admit`` /
+``serve_prefill`` / ``serve_first_token`` / ``serve_retire`` /
+``serve_preempt``) go to the flight recorder; engine gauges (live slots,
+page occupancy, queue depth, TTFT) to ``observability/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import json
+import time
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from distributeddeeplearning_tpu.serve import kv_cache
+from distributeddeeplearning_tpu.serve.scheduler import SloScheduler
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Everything that shapes the compiled serve programs, plus the one
+    volatile knob (``compile_cache_dir``) excluded from the fingerprint."""
+
+    model: str = "gpt_tiny"
+    vocab_size: int = 1024
+    dtype: str = "float32"
+    max_slots: int = 4                      # decode batch rows
+    page_size: int = 16                     # tokens per KV page
+    num_pages: int = 64                     # pool size, all slots share it
+    max_pages_per_slot: int = 8             # page-table width
+    prefill_buckets: tuple = (16, 32, 64)   # padded prompt lengths
+    seed: int = 0
+    compile_cache_dir: Optional[str] = None
+
+    @property
+    def slot_capacity(self) -> int:
+        """Max prompt+generated tokens a single slot can ever hold."""
+        return self.page_size * self.max_pages_per_slot
+
+
+def serve_fingerprint(config: ServeConfig) -> str:
+    """Stable hash of the program-shaping serve config (+ jax versions) —
+    the serving analogue of ``perf/aot.config_fingerprint``, which cannot
+    be reused directly because it resolves TrainConfig-only fields (fault
+    plans) that a ServeConfig does not have."""
+    import jax
+    import jaxlib
+
+    d = dataclasses.asdict(config)
+    d.pop("compile_cache_dir", None)  # volatile: where, not what
+    d["_versions"] = {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+    blob = json.dumps(d, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its accumulated lifecycle state."""
+
+    uid: int
+    tenant: str
+    prompt: list
+    max_new_tokens: int
+    arrival_s: float
+    tokens: list = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    itl_s: list = dataclasses.field(default_factory=list)
+    finished_s: Optional[float] = None
+    preemptions: int = 0
+    _last_emit_s: Optional[float] = None
+
+    @property
+    def total_tokens(self) -> int:
+        """Full page budget: prompt + every token it may ever emit."""
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def prefill_ids(self) -> list:
+        """What a (re-)admission prefills: the prompt plus everything
+        already emitted — after preemption the generated prefix is part
+        of the context, and greedy decoding continues it exactly."""
+        return list(self.prompt) + list(self.tokens)
+
+    @property
+    def output_ids(self) -> list:
+        return list(self.prompt) + list(self.tokens)
+
+    def emit(self, token: int, now: float) -> None:
+        if self.ttft_s is None:
+            self.ttft_s = now - self.arrival_s
+        elif self._last_emit_s is not None:
+            self.itl_s.append(now - self._last_emit_s)
+        self.tokens.append(int(token))
+        self._last_emit_s = now
+
+
+class _SlotView(NamedTuple):
+    """What the scheduler sees of a live slot."""
+
+    slot: int
+    tenant: str
+    num_pages: int
+    admitted_seq: int
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    pages: list
+    admitted_seq: int
+
+
+class Engine:
+    """Continuous-batching engine over one model replica.
+
+    ``clock`` is injectable (tests drive a fake clock; the bench uses
+    ``time.monotonic``). All host state is plain numpy/python; device
+    state is exactly (params, pools) with pools donated through both
+    programs, so XLA updates the KV pool in place every step.
+    """
+
+    def __init__(self, config: ServeConfig, *, model=None, variables=None,
+                 scheduler: Optional[SloScheduler] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from distributeddeeplearning_tpu.models import generate as genlib
+        from distributeddeeplearning_tpu.perf import aot as aotlib
+        from distributeddeeplearning_tpu.perf import compile_cache
+
+        cfg = config
+        if not cfg.prefill_buckets:
+            raise ValueError("prefill_buckets must name at least one "
+                             "padded prompt length")
+        self.config = cfg
+        self.scheduler = scheduler or SloScheduler()
+        self._clock = clock or time.monotonic
+        if model is None:
+            from distributeddeeplearning_tpu import models as modelslib
+            model = modelslib.model_spec(cfg.model).build(
+                vocab_size=cfg.vocab_size, dtype=getattr(jnp, cfg.dtype))
+        self.model = model
+        if variables is None:
+            probe = jnp.zeros((1, min(cfg.prefill_buckets)), jnp.int32)
+            variables = model.init({"params": jax.random.key(cfg.seed)},
+                                   probe, train=False)
+        self._fresh = {k: v for k, v in variables.items() if k != "cache"}
+
+        capacity = genlib.decode_capacity(model)
+        if capacity is not None and cfg.slot_capacity > capacity:
+            raise ValueError(
+                f"slot capacity {cfg.slot_capacity} tokens (page_size x "
+                f"max_pages_per_slot) exceeds the model's decode bound "
+                f"{capacity} — positions past it cannot be generated")
+        if max(cfg.prefill_buckets) > cfg.slot_capacity:
+            raise ValueError(
+                f"largest prefill bucket {max(cfg.prefill_buckets)} "
+                f"exceeds slot capacity {cfg.slot_capacity}")
+
+        self._pools = kv_cache.init_pools(
+            model, {**self._fresh}, num_pages=cfg.num_pages,
+            page_size=cfg.page_size)
+        self.allocator = kv_cache.PageAllocator(cfg.num_pages)
+        s, p = cfg.max_slots, cfg.max_pages_per_slot
+        self._page_table = np.zeros((s, p), np.int32)
+        self._lengths = np.zeros((s,), np.int32)
+        self._live = np.zeros((s,), bool)
+        self._feed = np.zeros((s, 1), np.int32)
+        self._slots: list = [None] * s
+        self.waiting: collections.deque = collections.deque()
+        self.finished: list = []
+        self._uid = 0
+        self._admitted_seq = 0
+        self.steps = 0
+        self.preemptions = 0
+
+        self._aot = aotlib.StepExecutableCache(
+            compile_cache.resolve_dir(cfg.compile_cache_dir),
+            serve_fingerprint(cfg))
+        self._prefill_exec: dict = {}
+        self._decode_exec = None
+
+    # -- public surface ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int,
+               tenant: str = "default",
+               arrival_s: Optional[float] = None) -> Request:
+        """Queue one request; admission happens on a later ``step()``."""
+        from distributeddeeplearning_tpu.models import generate as genlib
+
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt: prefill needs >= 1 token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}: a request "
+                             f"that emits nothing never leaves its slot")
+        total = len(prompt) + max_new_tokens
+        genlib._require_decode(self.model, total, request_totals=[total])
+        if total > self.config.slot_capacity:
+            raise ValueError(
+                f"request needs {total} tokens (prompt {len(prompt)} + "
+                f"max_new {max_new_tokens}) but a slot holds at most "
+                f"{self.config.slot_capacity} (page_size "
+                f"{self.config.page_size} x max_pages_per_slot "
+                f"{self.config.max_pages_per_slot})")
+        if len(prompt) > max(self.config.prefill_buckets):
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the largest "
+                f"prefill bucket {max(self.config.prefill_buckets)}")
+        req = Request(uid=self._uid, tenant=tenant, prompt=prompt,
+                      max_new_tokens=max_new_tokens,
+                      arrival_s=(self._clock() if arrival_s is None
+                                 else arrival_s))
+        self._uid += 1
+        self.waiting.append(req)
+        return req
+
+    @property
+    def num_live(self) -> int:
+        return int(self._live.sum())
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and self.num_live == 0
+
+    def step(self) -> list:
+        """One engine step: schedule, preempt, admit (+prefill), advance
+        every live slot one token, retire finished. Returns the requests
+        that finished during this step."""
+        from distributeddeeplearning_tpu.observability import metrics
+
+        now = self._clock()
+        finished_before = len(self.finished)
+        plan = self.scheduler.plan(
+            now=now, waiting=list(self.waiting), live=self._slot_views(),
+            free_slots=self.config.max_slots - self.num_live,
+            free_pages=self.allocator.free_pages,
+            page_size=self.config.page_size)
+        for slot in plan.preempt:
+            self._preempt(slot, now)
+        for req in plan.admit:
+            self.waiting.remove(req)
+            self._admit(req)
+        if self.num_live:
+            self._decode_step()
+        self.steps += 1
+        reg = metrics.get()
+        reg.observe("serve_live_slots", self.num_live, step=self.steps)
+        reg.observe("serve_page_occupancy",
+                    self.allocator.pages_in_use / self.config.num_pages,
+                    step=self.steps)
+        reg.observe("serve_queue_depth", len(self.waiting), step=self.steps)
+        return self.finished[finished_before:]
+
+    def run_until_idle(self, *, max_steps: int = 10_000) -> list:
+        """Drain queue + slots; returns all finished requests. The step
+        bound turns a scheduling livelock into a loud failure."""
+        for _ in range(max_steps):
+            if self.idle:
+                return self.finished
+            self.step()
+        raise RuntimeError(
+            f"engine not idle after {max_steps} steps: "
+            f"{len(self.waiting)} waiting, {self.num_live} live — "
+            f"scheduling livelock or a request that cannot ever fit")
+
+    def warmup(self) -> dict:
+        """Compile (or AOT-load) the decode program and every prefill
+        bucket without touching pool contents: the dummy prefill packs
+        zero positions (plen=0) and the dummy decode has no live rows, so
+        every pool write is dropped. Returns ``aot_stats()``."""
+        import jax.numpy as jnp
+
+        for bucket in sorted(self.config.prefill_buckets):
+            self._run_prefill(
+                np.zeros((1, bucket), np.int32), plen=0,
+                page_row=np.zeros((self.config.max_pages_per_slot,),
+                                  np.int32))
+        tok, pools = self._decode_program()(
+            self._fresh, jnp.asarray(self._feed),
+            jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+            jnp.asarray(self._live), self._pools)
+        tok.block_until_ready()
+        self._pools = pools
+        return self.aot_stats()
+
+    def aot_stats(self) -> dict:
+        return self._aot.stats()
+
+    # -- internals --------------------------------------------------------
+
+    def _slot_views(self) -> list:
+        return [_SlotView(slot=i, tenant=s.request.tenant,
+                          num_pages=len(s.pages),
+                          admitted_seq=s.admitted_seq)
+                for i, s in enumerate(self._slots) if s is not None]
+
+    def _bucket_for(self, plen: int) -> int:
+        for b in sorted(self.config.prefill_buckets):
+            if plen <= b:
+                return b
+        raise ValueError(
+            f"prefill of {plen} tokens exceeds the largest bucket "
+            f"{max(self.config.prefill_buckets)} — after preemption the "
+            f"generated prefix re-prefills too; size buckets to "
+            f"prompt + max_new_tokens")
+
+    def _program(self, name: str, fn, example_args, donate_argnums):
+        """Lower/compile through the AOT executable cache: warm replicas
+        deserialize instead of retracing."""
+        import jax
+
+        key = self._aot.key(name, example_args)
+        cached = self._aot.load(name, key)
+        if cached is not None:
+            return cached
+        compiled = jax.jit(fn, donate_argnums=donate_argnums).lower(
+            *example_args).compile()
+        self._aot.save(name, key, compiled)
+        return compiled
+
+    def _prefill_program(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        if bucket in self._prefill_exec:
+            return self._prefill_exec[bucket]
+
+        def prefill(fresh, ids, plen, page_row, pools):
+            logits, mut = self.model.apply(fresh, ids, train=False,
+                                           decode=True, mutable=["cache"])
+            pools = kv_cache.pack_prefill_cache(
+                mut["cache"], pools, page_row=page_row, plen=plen)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, plen - 1, 1, axis=1)[:, 0]
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)[0], pools
+
+        example = (self._fresh, jnp.zeros((1, bucket), jnp.int32),
+                   jnp.int32(0),
+                   jnp.zeros((self.config.max_pages_per_slot,), jnp.int32),
+                   self._pools)
+        exec_ = self._program(f"serve_prefill_{bucket}", prefill, example,
+                              donate_argnums=(4,))
+        self._prefill_exec[bucket] = exec_
+        return exec_
+
+    def _decode_program(self):
+        import jax.numpy as jnp
+
+        if self._decode_exec is not None:
+            return self._decode_exec
+
+        def decode(fresh, feed, page_table, lengths, live, pools):
+            state = kv_cache.PagedState(page_table, lengths, live)
+            logits, mut = self.model.apply(
+                {**fresh, "cache": pools}, feed, train=False, decode=True,
+                paged_state=state, mutable=["cache"])
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return tok, mut["cache"]
+
+        example = (self._fresh, jnp.asarray(self._feed),
+                   jnp.asarray(self._page_table),
+                   jnp.asarray(self._lengths), jnp.asarray(self._live),
+                   self._pools)
+        self._decode_exec = self._program("serve_decode", decode, example,
+                                          donate_argnums=(5,))
+        return self._decode_exec
+
+    def _run_prefill(self, padded: np.ndarray, *, plen: int,
+                     page_row: np.ndarray) -> int:
+        import jax.numpy as jnp
+
+        bucket = padded.shape[1]
+        tok, pools = self._prefill_program(bucket)(
+            self._fresh, jnp.asarray(padded), jnp.int32(plen),
+            jnp.asarray(page_row), self._pools)
+        self._pools = pools
+        return int(tok)
+
+    def _admit(self, req: Request) -> None:
+        from distributeddeeplearning_tpu.observability import flight
+
+        cfg = self.config
+        slot = next(i for i, s in enumerate(self._slots) if s is None)
+        need = kv_cache.pages_needed(req.total_tokens, cfg.page_size)
+        pages = self.allocator.alloc(need)
+        if pages is None:  # scheduler raced itself — re-queue, not crash
+            self.waiting.appendleft(req)
+            return
+        self._admitted_seq += 1
+        self._slots[slot] = _Slot(request=req, pages=pages,
+                                  admitted_seq=self._admitted_seq)
+        page_row = np.zeros((cfg.max_pages_per_slot,), np.int32)
+        page_row[:need] = pages
+        self._page_table[slot] = page_row
+
+        ids = req.prefill_ids
+        plen = len(ids)
+        bucket = self._bucket_for(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = ids
+        flight.get().record("serve_admit", request=req.uid,
+                            tenant=req.tenant, slot=slot, pages=need,
+                            resumed=bool(req.tokens))
+        tok = self._run_prefill(padded, plen=plen, page_row=page_row)
+        now = self._clock()
+        flight.get().record("serve_prefill", request=req.uid, slot=slot,
+                            bucket=bucket, prompt_tokens=plen)
+        first = req.ttft_s is None
+        req.emit(tok, now)
+        if first:
+            from distributeddeeplearning_tpu.observability import metrics
+            metrics.get().observe("serve_ttft_s", req.ttft_s,
+                                  step=self.steps)
+            flight.get().record("serve_first_token", request=req.uid,
+                                slot=slot, ttft_s=round(req.ttft_s, 6))
+        self._lengths[slot] = plen
+        self._live[slot] = True
+        self._feed[slot, 0] = tok
+        if req.remaining == 0:
+            self._retire(slot, now)
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+
+        toks, pools = self._decode_program()(
+            self._fresh, jnp.asarray(self._feed),
+            jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+            jnp.asarray(self._live), self._pools)
+        self._pools = pools
+        toks = np.asarray(toks)
+        now = self._clock()
+        for i in np.flatnonzero(self._live):
+            req = self._slots[i].request
+            req.emit(toks[i], now)
+            self._lengths[i] += 1
+            self._feed[i, 0] = toks[i]
+            if req.remaining == 0:
+                self._retire(int(i), now)
+
+    def _retire(self, slot: int, now: float) -> None:
+        from distributeddeeplearning_tpu.observability import flight
+
+        entry = self._slots[slot]
+        req = entry.request
+        req.finished_s = now
+        self.allocator.free(entry.pages)
+        self._clear_slot(slot)
+        self.finished.append(req)
+        flight.get().record("serve_retire", request=req.uid, slot=slot,
+                            tokens=len(req.tokens),
+                            preemptions=req.preemptions)
+
+    def _preempt(self, slot: int, now: float) -> None:
+        from distributeddeeplearning_tpu.observability import flight
+
+        entry = self._slots[slot]
+        req = entry.request
+        req.preemptions += 1
+        req._last_emit_s = None  # the gap back through the queue is not ITL
+        self.allocator.free(entry.pages)
+        self._clear_slot(slot)
+        self.waiting.append(req)
+        self.preemptions += 1
+        flight.get().record("serve_preempt", request=req.uid, slot=slot,
+                            tenant=req.tenant,
+                            tokens_done=len(req.tokens))
+
+    def _clear_slot(self, slot: int) -> None:
+        self._slots[slot] = None
+        self._live[slot] = False
+        self._lengths[slot] = 0
+        self._feed[slot, 0] = 0
+        self._page_table[slot] = 0

@@ -1,0 +1,256 @@
+"""Paged KV cache: fixed-size pages from a preallocated pool.
+
+The dense decode cache (models/gpt.py, models/llama.py) allocates
+``(B, max_position, heads, d)`` per request — memory scales with
+batch x the STATIC position bound even when most slots hold short, mostly
+finished sequences. Serving wants memory that scales with LIVE tokens:
+
+- one pool per attention layer, ``pages_k``/``pages_v`` of shape
+  ``(num_pages, page_size, kv_heads, head_dim)``, allocated once by the
+  engine and carried through the decode program as flax "cache" leaves
+  (donated, so XLA updates them in place);
+- a per-slot **page table** ``(max_slots, max_pages_per_slot)`` mapping
+  each slot's token range to pool pages in position order — entry ``j``
+  covers positions ``[j*page_size, (j+1)*page_size)``;
+- a host-side free list (:class:`PageAllocator`): admission takes pages,
+  retirement returns them, so a retiring slot's memory is reusable on the
+  very next step without any copying.
+
+Numerics match the dense decode branches exactly where it matters: same
+``d**-0.5`` scale, same f32 softmax over ``finfo(f32).min``-masked dead
+slots, and the gather is in page-table order == ascending positions, so a
+greedy argmax over paged logits equals the dense one (tests pin
+token-identity end to end).
+
+Leaf naming follows ``models/generate.py``'s taught-leaf scheme
+(:data:`~distributeddeeplearning_tpu.models.generate.CACHE_LEAF_KINDS`):
+``pages_k``/``pages_v`` are registered there as kind "pool", so the beam
+path rejects them explicitly instead of mis-expanding page rows as batch
+rows.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Dense decode-cache leaf -> its paged pool counterpart. Shared by the
+# prefill packing below and by pool initialization, so the mapping lives
+# in exactly one place.
+POOL_FOR_DENSE = {"cached_key": "pages_k", "cached_value": "pages_v"}
+
+
+class PagedState(NamedTuple):
+    """Per-step view of the slot table, passed into the decode program.
+
+    ``page_table`` (max_slots, max_pages_per_slot) int32 — pool page ids in
+    position order; entries past a slot's allocation are arbitrary (their
+    gathered K/V is masked by ``lengths``).
+    ``lengths`` (max_slots,) int32 — tokens already cached per slot; also
+    the position of the token being decoded this step. 0 for dead slots.
+    ``live`` (max_slots,) bool — whether the slot holds an active request;
+    dead slots' writes are dropped (out-of-range index, ``mode="drop"``).
+    """
+
+    page_table: jax.Array
+    lengths: jax.Array
+    live: jax.Array
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    """Pages covering ``total_tokens`` positions (ceil division)."""
+    return -(-int(total_tokens) // int(page_size))
+
+
+def unseeded_pool(name: str):
+    """init_fn for the models' ``self.variable("cache", "pages_k"/...)``:
+    pool shapes are an ENGINE decision (num_pages x page_size), not a model
+    one, so a paged decode whose cache collection lacks the pool is a
+    wiring bug — fail loudly instead of inventing a shape."""
+    def init():
+        raise ValueError(
+            f"paged decode needs the '{name}' pool seeded in "
+            f"variables['cache'] by the serve engine "
+            f"(serve/engine.py builds it via kv_cache.init_pools); "
+            f"models never size pool memory themselves")
+    return init
+
+
+def paged_attention_step(q, k_new, v_new, pool_k, pool_v,
+                         state: PagedState):
+    """One decode step of paged attention for every slot at once.
+
+    ``q`` (S, 1, heads, d); ``k_new``/``v_new`` (S, 1, kv_heads, d) — the
+    current token's projections per slot (RoPE already applied for Llama).
+    Writes each live slot's K/V at position ``lengths[i]`` into its page,
+    then attends slot ``i``'s query over its own gathered pages.
+
+    Returns ``(out, pool_k, pool_v)`` with ``out`` (S, 1, heads*d). Dead
+    slots produce garbage rows (masked softmax over one arbitrary slot —
+    finite, never NaN) that the engine discards; their writes are dropped
+    via an out-of-range flat index with ``mode="drop"``.
+    """
+    num_pages, page_size, kvh, d = pool_k.shape
+    slots = q.shape[0]
+    heads = q.shape[2]
+    rep = heads // kvh
+    lengths = state.lengths
+
+    # --- write: slot i's token lands at flat pool row
+    #     page_table[i, lengths[i] // page_size] * page_size + offset ----
+    page_col = lengths // page_size
+    page_id = jnp.take_along_axis(state.page_table, page_col[:, None],
+                                  axis=1)[:, 0]
+    flat_idx = page_id * page_size + lengths % page_size
+    flat_idx = jnp.where(state.live, flat_idx, num_pages * page_size)
+    flat_k = pool_k.reshape(num_pages * page_size, kvh, d)
+    flat_v = pool_v.reshape(num_pages * page_size, kvh, d)
+    flat_k = flat_k.at[flat_idx].set(k_new[:, 0].astype(pool_k.dtype),
+                                     mode="drop")
+    flat_v = flat_v.at[flat_idx].set(v_new[:, 0].astype(pool_v.dtype),
+                                     mode="drop")
+    pool_k = flat_k.reshape(pool_k.shape)
+    pool_v = flat_v.reshape(pool_v.shape)
+
+    # --- gather: page-table order == ascending positions, so slot i's
+    #     context is a contiguous [0, lengths[i]] prefix of the gather ----
+    k_ctx = pool_k[state.page_table].reshape(slots, -1, kvh, d)
+    v_ctx = pool_v[state.page_table].reshape(slots, -1, kvh, d)
+    ctx = k_ctx.shape[1]  # max_pages_per_slot * page_size
+
+    qg = q.reshape(slots, 1, kvh, rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_ctx) * (d ** -0.5)
+    # The query sits at position lengths[i] (just written): visible slots
+    # are 0..lengths[i] inclusive — same rule as the dense branches'
+    # ``arange <= idx``.
+    visible = (jnp.arange(ctx)[None, :]
+               <= lengths[:, None])[:, None, None, None, :]
+    scores = jnp.where(visible, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_ctx)
+    return out.reshape(slots, 1, heads * d), pool_k, pool_v
+
+
+def init_pools(model, variables, *, num_pages: int, page_size: int):
+    """Zeroed per-layer pools matching the model's dense cache tree.
+
+    Discovers each attention layer's (kv_heads, head_dim, dtype) by
+    ``jax.eval_shape`` over a dense decode prefill — no FLOPs, no memory —
+    then mirrors every ``cached_key``/``cached_value`` leaf as a
+    ``pages_k``/``pages_v`` pool of shape
+    ``(num_pages, page_size, kv_heads, head_dim)`` at the same tree path.
+    """
+    from flax import traverse_util
+
+    from distributeddeeplearning_tpu.models.generate import CACHE_LEAF_KINDS
+
+    fresh = {k: v for k, v in variables.items() if k != "cache"}
+    probe = jnp.zeros((1, 1), jnp.int32)
+    _, shapes = jax.eval_shape(
+        lambda v, ids: model.apply(v, ids, train=False, decode=True,
+                                   mutable=["cache"]),
+        fresh, probe)
+    pools = {}
+    for path, leaf in traverse_util.flatten_dict(shapes["cache"]).items():
+        kind = CACHE_LEAF_KINDS.get(path[-1])
+        if kind != "batched":
+            continue  # scalars have no paged counterpart
+        _, _, kvh, d = leaf.shape
+        pools[path[:-1] + (POOL_FOR_DENSE[path[-1]],)] = jnp.zeros(
+            (num_pages, page_size, kvh, d), leaf.dtype)
+    if not pools:
+        raise ValueError(
+            f"{type(model).__name__} produced no dense K/V cache leaves "
+            f"under decode=True — paged serving needs the GPT/Llama "
+            f"decode mode")
+    return traverse_util.unflatten_dict(pools)
+
+
+def pack_prefill_cache(dense_cache, pools, *, page_row, plen):
+    """Scatter one slot's dense prefill cache into its pages.
+
+    ``dense_cache`` is the mutated "cache" collection of a batch-1 dense
+    decode prefill (prompt right-padded to a bucket length L);
+    ``page_row`` (max_pages_per_slot,) int32 is the slot's page-table row;
+    ``plen`` (traced scalar ok) is the real prompt length — positions
+    ``[0, plen)`` are written, pad positions ``[plen, L)`` are dropped via
+    an out-of-range index, so one compiled program serves every prompt
+    length within the bucket.
+
+    Leaves are classified through CACHE_LEAF_KINDS: batched K/V leaves map
+    to their pools, scalars (cache_index / position) are prefill-local and
+    skipped, anything unknown raises.
+    """
+    from flax import traverse_util
+
+    from distributeddeeplearning_tpu.models.generate import CACHE_LEAF_KINDS
+
+    flat_dense = traverse_util.flatten_dict(dense_cache)
+    flat_pools = traverse_util.flatten_dict(pools)
+    for path, leaf in flat_dense.items():
+        kind = CACHE_LEAF_KINDS.get(path[-1])
+        if kind == "scalar":
+            continue
+        if kind != "batched":
+            raise ValueError(
+                f"prefill cache leaf {'/'.join(map(str, path))} is not "
+                f"classified in CACHE_LEAF_KINDS — teach it there before "
+                f"packing it into pages")
+        dest = path[:-1] + (POOL_FOR_DENSE[path[-1]],)
+        pool = flat_pools[dest]
+        num_pages, page_size, kvh, d = pool.shape
+        length = leaf.shape[1]
+        t = jnp.arange(length)
+        flat_idx = page_row[t // page_size] * page_size + t % page_size
+        flat_idx = jnp.where(t < plen, flat_idx, num_pages * page_size)
+        flat_pool = pool.reshape(num_pages * page_size, kvh, d)
+        flat_pool = flat_pool.at[flat_idx].set(
+            leaf[0].astype(pool.dtype), mode="drop")
+        flat_pools[dest] = flat_pool.reshape(pool.shape)
+    return traverse_util.unflatten_dict(flat_pools)
+
+
+class PageAllocator:
+    """Host-side free-list page allocator: admission takes, retirement
+    returns, double-free raises (a page on two slots' tables corrupts both
+    sequences silently — the one failure mode this class exists to make
+    impossible)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages={num_pages}: need >= 1")
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._held: set[int] = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """``n`` page ids, or None (allocate-all-or-nothing) when the pool
+        cannot cover the request — admission control's budget check."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._held.update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"double-free of page {p}: it is not currently "
+                    f"allocated — a page on two page tables would corrupt "
+                    f"both slots' K/V")
+            self._held.discard(p)
+            self._free.append(p)

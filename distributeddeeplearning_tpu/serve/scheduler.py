@@ -1,0 +1,140 @@
+"""Per-tenant SLO-aware admission / preemption for the serve engine.
+
+Pure host-side policy, fully deterministic, zero jax: the engine hands it
+the wait queue and the live-slot table each step, and it returns a
+:class:`Plan` — who to admit (in order) and at most one slot to preempt.
+Keeping it pure makes every policy decision unit-testable without a model.
+
+Policy, in the order it is applied:
+
+1. **Priority = deadline slack.** Each waiting request's slack is
+   ``(arrival + tenant.ttft_slo_s) - now``; the queue is served most
+   negative (most overdue) first, ties broken by arrival then uid — FIFO
+   within a tenant class.
+2. **Admission by free-page budget.** A request needs
+   ``pages_needed(prompt + max_new_tokens)`` pages and one free slot,
+   allocate-all-or-nothing — a slot that could run out of pages mid-decode
+   would corrupt its own tail, so the full budget is reserved up front.
+   A tenant with ``max_pages`` set is also capped across its live slots:
+   over-budget tenants simply stop admitting.
+3. **Preemption (at most one per plan).** When the most urgent
+   *within-budget* request is starved — of a slot or of pages — the most
+   recently admitted live slot of an OVER-budget tenant is preempted:
+   its slot and pages return, and its request re-queues with everything
+   generated so far folded into the prompt (greedy decoding makes the
+   continuation deterministic, so no work is lost — tests pin
+   token-identity across preemption). One per step bounds thrash; the
+   next step re-evaluates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from distributeddeeplearning_tpu.serve.kv_cache import pages_needed
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """What the engine owes a tenant (TTFT SLO) and what the tenant may
+    hold (page cap across its live slots; None = uncapped)."""
+
+    name: str
+    ttft_slo_s: float = 1.0
+    max_pages: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One step's scheduling decision: requests to admit, in priority
+    order, and at most one live slot id to preempt first."""
+
+    admit: tuple
+    preempt: tuple
+
+    @property
+    def empty(self) -> bool:
+        return not self.admit and not self.preempt
+
+
+class SloScheduler:
+    """Deadline-slack scheduler over the engine's wait queue.
+
+    ``policies`` maps tenant name -> :class:`TenantPolicy`; unknown
+    tenants get ``default_policy``.
+    """
+
+    def __init__(self, policies: Optional[Sequence[TenantPolicy]] = None,
+                 default_policy: Optional[TenantPolicy] = None):
+        self.default_policy = default_policy or TenantPolicy("default")
+        self.policies = {p.name: p for p in (policies or ())}
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, self.default_policy)
+
+    def slack_s(self, request, now: float) -> float:
+        """Seconds until (negative: since) the tenant's TTFT deadline."""
+        return (request.arrival_s + self.policy(request.tenant).ttft_slo_s
+                - now)
+
+    def plan(self, *, now: float, waiting: Sequence, live: Sequence,
+             free_slots: int, free_pages: int, page_size: int) -> Plan:
+        """``waiting``: requests (``tenant``/``arrival_s``/``uid`` plus
+        ``total_tokens`` = prompt+emitted+remaining). ``live``: slot views
+        with ``slot``/``tenant``/``num_pages``/``admitted_seq``."""
+        tenant_pages: dict[str, int] = {}
+        for s in live:
+            tenant_pages[s.tenant] = (tenant_pages.get(s.tenant, 0)
+                                      + s.num_pages)
+
+        order = sorted(waiting,
+                       key=lambda r: (self.slack_s(r, now), r.arrival_s,
+                                      r.uid))
+        admit: list = []
+        preempt: list = []
+        preempted_tenants: set[str] = set()
+        for req in order:
+            pol = self.policy(req.tenant)
+            need = pages_needed(req.total_tokens, page_size)
+            if (pol.max_pages is not None
+                    and tenant_pages.get(req.tenant, 0) + need
+                    > pol.max_pages):
+                continue  # over-budget tenant: holds its place, no slot
+            if free_slots <= 0 or need > free_pages:
+                if preempt:  # at most one eviction per plan
+                    break
+                # Slot- and page-starvation evict alike: the victim's
+                # slot AND pages both return.
+                victim = self._victim(live, tenant_pages,
+                                      exclude=preempted_tenants)
+                if victim is not None and (free_pages + victim.num_pages
+                                           >= need):
+                    preempt.append(victim.slot)
+                    preempted_tenants.add(victim.tenant)
+                    tenant_pages[victim.tenant] -= victim.num_pages
+                    free_pages += victim.num_pages
+                    free_slots += 1
+                else:
+                    break  # starved and nothing evictable: wait
+            admit.append(req)
+            free_slots -= 1
+            free_pages -= need
+            tenant_pages[req.tenant] = tenant_pages.get(req.tenant, 0) + need
+        return Plan(admit=tuple(admit), preempt=tuple(preempt))
+
+    def _victim(self, live: Sequence, tenant_pages: dict,
+                exclude: set):
+        """Most recently admitted slot of an over-budget tenant (newest
+        first minimizes wasted decode work), or None when every tenant is
+        within budget — within-budget work is never evicted."""
+        candidates = []
+        for s in live:
+            pol = self.policy(s.tenant)
+            if s.tenant in exclude or pol.max_pages is None:
+                continue
+            if tenant_pages.get(s.tenant, 0) > pol.max_pages:
+                candidates.append(s)
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.admitted_seq)

@@ -59,6 +59,9 @@ def pytest_runtest_logreport(report):
         # lint likewise: tools/marker_audit.py --expect-lint verifies the
         # ddl-lint static-analysis gate actually ran in this tier-1 pass.
         "lint": "lint" in report.keywords,
+        # serve likewise: tools/marker_audit.py --expect-serve verifies the
+        # engine token-identity pin survived in tier-1.
+        "serve": "serve" in report.keywords,
     })
 
 

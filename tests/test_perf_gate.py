@@ -199,6 +199,57 @@ def runner_zero2():
     return perf_gate.ProxyRunner(perf_gate.WORKLOADS["zero2_overlap"])
 
 
+# --- the serve_decode extras workload ---------------------------------------
+
+@pytest.fixture(scope="module")
+def runner_serve():
+    """ONE warmed serve engine (tiny paged-KV config) shared by the
+    serve-decode gate tests."""
+    return perf_gate.ServeProxyRunner()
+
+
+@pytest.mark.perf_gate
+@pytest.mark.serve
+def test_perf_gate_live_serve_decode(runner_serve, monkeypatch, tmp_path):
+    """The serve-engine gate: one continuous-batching decode step (all
+    slots live) must sit inside its extras baseline band — a retrace,
+    accidental pool copy, or host-loop bloat in serve/engine.py fails
+    tier-1 here. Recalibrate with
+    `python tools/perf_gate.py --recalibrate --workload serve_decode`."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner_serve, workload="serve_decode")
+    assert result["ok"], "\n".join(result["violations"])
+    assert result["workload_name"] == "serve_decode"
+    assert result["current"]["workload"]["kind"] == "serve_decode"
+    # A serve-workload check must never overwrite the headline sidecar.
+    assert not (tmp_path / "last.json").exists()
+
+
+@pytest.mark.perf_gate
+@pytest.mark.serve
+def test_serve_decode_gate_flips_on_injected_stall(runner_serve):
+    """The armed-gate self-test for the serve workload: a deliberate host
+    stall between decode steps must trip step time out of band AND the
+    host_stall phase share."""
+    baseline = perf_gate.load_baseline(name="serve_decode")
+    slow = runner_serve.measure(inject_sleep_s=0.2)
+    violations = perf_gate.compare(baseline, slow)
+    assert any("step-time regression" in v for v in violations), violations
+    assert any("phase-mix regression" in v and "host_stall" in v
+               for v in violations), violations
+
+
+def test_serve_decode_workload_is_registered():
+    """The CLI's --workload choices come from WORKLOADS; losing the entry
+    silently removes the serve gate from tools/perf_gate.py."""
+    w = perf_gate.WORKLOADS["serve_decode"]
+    assert w["kind"] == "serve_decode"
+    assert w["max_slots"] >= 2  # a 1-slot proxy would not batch at all
+    # And its baseline ships in perf_baselines.json (extras entry).
+    assert perf_gate.load_baseline(name="serve_decode") is not None
+
+
 @pytest.mark.perf_gate
 def test_perf_gate_live_zero2_overlap(runner_zero2, monkeypatch, tmp_path):
     """The sharded-schedule gate: the overlapped ZeRO-2 proxy must sit

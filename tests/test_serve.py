@@ -1,0 +1,351 @@
+"""Continuous-batching serve engine (distributeddeeplearning_tpu/serve/).
+
+The load-bearing pin is TOKEN-IDENTITY: the engine's greedy output must
+equal sequential ``generate(use_cache=True)`` request-by-request — with
+slots retiring and admitting mid-stream, for both model families, and
+across a preemption/resume cycle. If that holds, the paged cache, the
+prefill packing, the per-row positions, and the masked paged attention
+are all simultaneously correct (any one of them wrong changes tokens).
+Around the pin: numeric paged-vs-dense attention equivalence, allocator
+and scheduler policy units, per-request capacity errors, the AOT
+zero-retrace warm boot, and a bench_serve smoke through the
+provenance-validated record schema.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearning_tpu.models import generate as genlib
+from distributeddeeplearning_tpu.models import model_spec
+from distributeddeeplearning_tpu.serve import kv_cache
+from distributeddeeplearning_tpu.serve.engine import (Engine, ServeConfig,
+                                                      serve_fingerprint)
+from distributeddeeplearning_tpu.serve.scheduler import (Plan, SloScheduler,
+                                                         TenantPolicy)
+
+pytestmark = pytest.mark.serve
+
+VOCAB = 97
+
+
+def _engine(model="gpt_tiny", **kw):
+    kw.setdefault("vocab_size", VOCAB)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("max_pages_per_slot", 8)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("compile_cache_dir", "off")
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001  # strictly increasing: every emit gets a distinct time
+        return t[0]
+
+    return Engine(ServeConfig(model=model, **kw), clock=clock)
+
+
+def _reference_tokens(eng, prompt, max_new):
+    out = genlib.generate(eng.model, {**eng._fresh},
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=max_new, use_cache=True)
+    return [int(x) for x in np.asarray(out)[0, len(prompt):]]
+
+
+# --- kv_cache units ---------------------------------------------------------
+
+def test_pages_needed_is_ceil_division():
+    assert kv_cache.pages_needed(1, 4) == 1
+    assert kv_cache.pages_needed(4, 4) == 1
+    assert kv_cache.pages_needed(5, 4) == 2
+    assert kv_cache.pages_needed(17, 4) == 5
+
+
+def test_allocator_all_or_nothing_reuse_and_double_free():
+    alloc = kv_cache.PageAllocator(4)
+    a = alloc.alloc(3)
+    assert len(a) == 3 and alloc.free_pages == 1
+    # All-or-nothing: a 2-page ask against 1 free page takes NOTHING.
+    assert alloc.alloc(2) is None
+    assert alloc.free_pages == 1
+    alloc.free(a)
+    assert alloc.free_pages == 4
+    # Freed pages are immediately reusable...
+    b = alloc.alloc(4)
+    assert sorted(b) == sorted(range(4))
+    # ...and a page can never sit on two tables at once.
+    alloc.free([b[0]])
+    with pytest.raises(ValueError, match="double-free"):
+        alloc.free([b[0]])
+
+
+def test_paged_attention_matches_dense_reference():
+    """Paged gather+mask attention == plain softmax attention over each
+    slot's logical [0, length] context, per (grouped) head — the numeric
+    core the token-identity pins rest on."""
+    rng = np.random.default_rng(0)
+    slots, page_size, pages_per_slot, num_pages = 3, 4, 2, 8
+    kvh, heads, d = 2, 4, 8
+    rep = heads // kvh
+    lengths = np.array([3, 5, 0], np.int32)
+    live = np.array([True, True, False])
+    table = np.array([[2, 5], [1, 6], [0, 0]], np.int32)
+
+    pool_k = rng.standard_normal((num_pages, page_size, kvh, d)).astype(
+        np.float32)
+    pool_v = rng.standard_normal((num_pages, page_size, kvh, d)).astype(
+        np.float32)
+    q = rng.standard_normal((slots, 1, heads, d)).astype(np.float32)
+    k_new = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+    v_new = rng.standard_normal((slots, 1, kvh, d)).astype(np.float32)
+
+    out, pk, pv = kv_cache.paged_attention_step(
+        jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+        jnp.asarray(pool_k), jnp.asarray(pool_v),
+        kv_cache.PagedState(jnp.asarray(table), jnp.asarray(lengths),
+                            jnp.asarray(live)))
+    out, pk, pv = np.asarray(out), np.asarray(pk), np.asarray(pv)
+
+    # Live slots' k_new landed at position lengths[i]; the dead slot's
+    # write was dropped (pool unchanged everywhere it didn't own).
+    for i in range(slots):
+        if not live[i]:
+            continue
+        page = table[i, lengths[i] // page_size]
+        np.testing.assert_array_equal(
+            pk[page, lengths[i] % page_size], k_new[i, 0])
+    np.testing.assert_array_equal(pv[3], pool_v[3])  # page 3: never owned
+
+    for i in range(slots):
+        if not live[i]:
+            continue
+        # Logical context rows 0..lengths[i], gathered in page order.
+        rows_k = [pk[table[i, t // page_size], t % page_size]
+                  for t in range(lengths[i] + 1)]
+        rows_v = [pv[table[i, t // page_size], t % page_size]
+                  for t in range(lengths[i] + 1)]
+        K, V = np.stack(rows_k), np.stack(rows_v)  # (len+1, kvh, d)
+        for h in range(heads):
+            g = h // rep
+            s = (q[i, 0, h] @ K[:, g].T) * d ** -0.5
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            np.testing.assert_allclose(out[i, 0, h * d:(h + 1) * d],
+                                       p @ V[:, g], rtol=1e-5, atol=1e-5)
+
+
+def test_beam_path_rejects_paged_pool_leaves():
+    cache = {"layer_0": {"attn": {"pages_k": jnp.zeros((4, 2, 1, 8))}}}
+    with pytest.raises(ValueError, match="beam context"):
+        genlib._map_batched_cache(cache, lambda x: x)
+
+
+# --- scheduler policy units -------------------------------------------------
+
+def _req(uid, tenant="default", arrival=0.0, total=8):
+    class R:
+        pass
+    r = R()
+    r.uid, r.tenant, r.arrival_s, r.total_tokens = uid, tenant, arrival, total
+    return r
+
+
+def _slot(slot, tenant, num_pages, seq):
+    from distributeddeeplearning_tpu.serve.engine import _SlotView
+    return _SlotView(slot=slot, tenant=tenant, num_pages=num_pages,
+                     admitted_seq=seq)
+
+
+def test_scheduler_orders_by_deadline_slack_then_fifo():
+    sched = SloScheduler([TenantPolicy("rt", ttft_slo_s=0.1),
+                          TenantPolicy("batch", ttft_slo_s=10.0)])
+    # batch arrived FIRST but has 10 s of slack; rt is nearly overdue.
+    plan = sched.plan(now=1.0,
+                      waiting=[_req(0, "batch", arrival=0.0),
+                               _req(1, "rt", arrival=0.95)],
+                      live=[], free_slots=2, free_pages=100, page_size=4)
+    assert [r.uid for r in plan.admit] == [1, 0]
+    # Same tenant class: FIFO by arrival.
+    plan = sched.plan(now=1.0,
+                      waiting=[_req(3, "rt", arrival=0.6),
+                               _req(2, "rt", arrival=0.5)],
+                      live=[], free_slots=2, free_pages=100, page_size=4)
+    assert [r.uid for r in plan.admit] == [2, 3]
+
+
+def test_scheduler_admission_respects_pages_and_tenant_budget():
+    sched = SloScheduler([TenantPolicy("capped", max_pages=3)])
+    # 2 free pages cannot cover a 3-page request: nothing admitted.
+    plan = sched.plan(now=0.0, waiting=[_req(0, total=12)], live=[],
+                      free_slots=1, free_pages=2, page_size=4)
+    assert plan.empty
+    # Tenant budget counts LIVE pages: capped holds 2, another 2-page
+    # request would exceed max_pages=3 and is skipped — but an uncapped
+    # tenant behind it still admits (the capped one holds its queue spot,
+    # not the whole engine).
+    plan = sched.plan(now=0.0,
+                      waiting=[_req(0, "capped", arrival=0.0, total=8),
+                               _req(1, "other", arrival=1.0, total=8)],
+                      live=[_slot(0, "capped", 2, seq=1)],
+                      free_slots=1, free_pages=10, page_size=4)
+    assert [r.uid for r in plan.admit] == [1]
+    assert not plan.preempt
+
+
+def test_scheduler_preempts_newest_overbudget_slot_only():
+    sched = SloScheduler([TenantPolicy("bg", max_pages=2)])
+    live = [_slot(0, "bg", 3, seq=1), _slot(1, "bg", 3, seq=2)]
+    # bg holds 6 pages against a budget of 2; a starved request (needs 2,
+    # 0 free) evicts exactly ONE bg slot — the newest (seq=2), minimizing
+    # wasted decode work.
+    plan = sched.plan(now=0.0, waiting=[_req(0, "rt", total=8)], live=live,
+                      free_slots=0, free_pages=0, page_size=4)
+    assert plan.preempt == (1,)
+    assert [r.uid for r in plan.admit] == [0]
+    # Within-budget work is never evicted.
+    sched2 = SloScheduler()
+    plan = sched2.plan(now=0.0, waiting=[_req(0, total=8)],
+                       live=[_slot(0, "default", 3, seq=1)],
+                       free_slots=1, free_pages=0, page_size=4)
+    assert plan.empty
+
+
+# --- the token-identity pins ------------------------------------------------
+
+@pytest.mark.parametrize("model", ["gpt_tiny", "llama_tiny"])
+def test_engine_token_identity_with_midstream_retire_admit(model):
+    """Five requests through two slots: slots retire and re-admit while
+    others are mid-decode, and every request's greedy tokens must equal a
+    sequential generate(use_cache=True) run of that request alone."""
+    eng = _engine(model)
+    rng = np.random.default_rng(0)
+    lens = [(5, 6), (7, 4), (3, 8), (6, 5), (8, 3)]
+    reqs = [eng.submit([int(x) for x in rng.integers(1, VOCAB, p)],
+                       max_new_tokens=m) for p, m in lens]
+    eng.run_until_idle()
+    assert eng.idle and len(eng.finished) == len(reqs)
+    for r in reqs:
+        assert r.tokens == _reference_tokens(eng, r.prompt,
+                                             r.max_new_tokens), r.uid
+        assert r.ttft_s is not None and r.finished_s is not None
+        assert len(r.tokens) == r.max_new_tokens
+    # Every page came back to the free list.
+    assert eng.allocator.free_pages == eng.config.num_pages
+
+
+def test_engine_preemption_resumes_token_identical():
+    """Tighten a tenant's page budget mid-run (the operational reconfig
+    path), submit a starved higher-urgency request, and the over-budget
+    victim must be preempted, re-queued, and finish with EXACTLY the
+    tokens of an uninterrupted sequential run."""
+    eng = _engine("gpt_tiny", max_slots=2, page_size=4, num_pages=8,
+                  max_pages_per_slot=8, prefill_buckets=(8, 16))
+    rng = np.random.default_rng(1)
+    bg_prompt = [int(x) for x in rng.integers(1, VOCAB, 4)]
+    bg = eng.submit(bg_prompt, max_new_tokens=12, tenant="bg")  # 4 pages
+    eng.step()
+    eng.step()
+    assert eng.num_live == 1 and len(bg.tokens) >= 2
+
+    eng.scheduler.policies["bg"] = TenantPolicy("bg", max_pages=3)
+    rt_prompt = [int(x) for x in rng.integers(1, VOCAB, 8)]
+    rt = eng.submit(rt_prompt, max_new_tokens=12, tenant="rt")  # 5 pages
+    eng.step()  # rt needs 5 of 4 free pages -> bg (4 held > 3) evicted
+    assert eng.preemptions == 1 and bg.preemptions == 1
+    assert bg in list(eng.waiting)
+
+    del eng.scheduler.policies["bg"]  # restore so bg can re-admit
+    eng.run_until_idle()
+    assert rt.tokens == _reference_tokens(eng, rt_prompt, 12)
+    assert bg.tokens == _reference_tokens(eng, bg_prompt, 12)
+    assert eng.allocator.free_pages == eng.config.num_pages
+
+
+def test_engine_aot_warm_boot_zero_retrace(tmp_path):
+    """Second engine with the same fingerprint deserializes every program
+    (prefill per bucket + decode) instead of retracing — and still
+    decodes token-identically."""
+    kw = dict(max_slots=2, page_size=4, num_pages=16, max_pages_per_slot=4,
+              prefill_buckets=(8,), compile_cache_dir=str(tmp_path))
+    cold = _engine("gpt_tiny", **kw)
+    stats = cold.warmup()
+    assert stats["aot_misses"] == 2 and stats["aot_saves"] == 2
+    prompt = list(range(1, 6))
+    cold_req = cold.submit(prompt, max_new_tokens=4)
+    cold.run_until_idle()
+
+    warm = _engine("gpt_tiny", **kw)
+    stats = warm.warmup()
+    assert stats["aot_hits"] == 2 and stats["aot_misses"] == 0
+    warm_req = warm.submit(prompt, max_new_tokens=4)
+    warm.run_until_idle()
+    assert warm_req.tokens == cold_req.tokens
+
+
+def test_serve_fingerprint_tracks_program_shape_not_cache_dir():
+    a = ServeConfig(compile_cache_dir=None)
+    b = ServeConfig(compile_cache_dir="/somewhere/else")
+    c = ServeConfig(page_size=a.page_size * 2)
+    assert serve_fingerprint(a) == serve_fingerprint(b)
+    assert serve_fingerprint(a) != serve_fingerprint(c)
+
+
+# --- capacity errors --------------------------------------------------------
+
+def test_require_decode_names_offending_request():
+    model = model_spec("gpt_tiny").build(vocab_size=VOCAB)  # max_position 128
+    with pytest.raises(ValueError, match=r"request 1 .*over by 72"):
+        genlib._require_decode(model, 200, request_totals=[100, 200, 120])
+
+
+def test_submit_rejects_oversized_requests():
+    eng = _engine("gpt_tiny", max_slots=1, page_size=4, num_pages=16,
+                  max_pages_per_slot=4, prefill_buckets=(8,))
+    with pytest.raises(ValueError, match="slot holds at most 16"):
+        eng.submit(list(range(1, 9)), max_new_tokens=9)
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        eng.submit(list(range(1, 11)), max_new_tokens=2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], max_new_tokens=2)
+
+
+def test_engine_rejects_capacity_exceeding_config():
+    with pytest.raises(ValueError, match="decode bound"):
+        # gpt_tiny's max_position is 128; 64-token pages x 4 = 256 > 128.
+        Engine(ServeConfig(model="gpt_tiny", vocab_size=VOCAB, max_slots=1,
+                           page_size=64, num_pages=8, max_pages_per_slot=4,
+                           prefill_buckets=(16,), compile_cache_dir="off"))
+
+
+# --- bench record smoke -----------------------------------------------------
+
+def test_bench_serve_emits_valid_provenance_record(tmp_path, monkeypatch,
+                                                   capsys):
+    from distributeddeeplearning_tpu.observability import perf_report
+    from tools import bench_serve
+
+    written = {}
+    from distributeddeeplearning_tpu.observability import sidecars
+    monkeypatch.setattr(sidecars, "write",
+                        lambda name, payload: written.update(
+                            {name: payload}) or str(tmp_path / "s.json"))
+    rc = bench_serve.main([
+        "--model", "gpt_tiny", "--vocab-size", str(VOCAB),
+        "--requests", "3", "--rate", "1000", "--max-new", "3",
+        "--prompt-lens", "4,6", "--max-slots", "2", "--page-size", "4",
+        "--num-pages", "16", "--max-pages-per-slot", "4",
+        "--prefill-buckets", "8", "--compile-cache-dir", "off"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert perf_report.validate(rec) == []
+    assert rec["provenance"] == "fresh"
+    assert rec["token_identity_checked"] is True
+    assert rec["continuous"]["finished"] == 3
+    assert rec["sequential_baseline"]["tokens_per_sec_per_chip"] > 0
+    assert "speedup_vs_sequential" in rec
+    assert "last_serve" in written

@@ -45,6 +45,7 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from distributeddeeplearning_tpu.models import flops as flopslib
     from distributeddeeplearning_tpu.models import model_spec
     from distributeddeeplearning_tpu.models.generate import generate
     from distributeddeeplearning_tpu.observability import perf_report
@@ -62,6 +63,11 @@ def main(argv=None) -> int:
     variables = model.init({"params": jax.random.key(0)}, prompt[:, :8],
                            train=False)
 
+    # Roofline context: decode sweeps positions prompt..prompt+new, so the
+    # mid-decode context is the representative KV-read size for the row.
+    mid_context = args.prompt_len + args.new_tokens // 2
+    device_kind = getattr(jax.devices()[0], "device_kind", "")
+
     def timed(use_cache: bool) -> None:
         t_c = time.perf_counter()
         out = generate(model, variables, prompt,
@@ -73,15 +79,24 @@ def main(argv=None) -> int:
                        max_new_tokens=args.new_tokens, use_cache=use_cache)
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
-        print(json.dumps(perf_report.annotate({
+        value = round(args.batch * args.new_tokens / dt, 1)
+        rec = {
             "metric": f"{args.model}_decode_tokens_per_sec",
             "mode": "kv_cache" if use_cache else "full_refeed",
-            "value": round(args.batch * args.new_tokens / dt, 1),
+            "value": value,
             "unit": "tokens/sec",
             "batch": args.batch, "prompt_len": args.prompt_len,
             "new_tokens": args.new_tokens,
             "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
-        }, provenance="fresh")), flush=True)
+        }
+        roof = flopslib.decode_roofline(
+            args.model, context_len=mid_context,
+            tokens_per_sec=value / jax.device_count(),
+            device_kind=device_kind, batch=args.batch)
+        if roof:
+            rec["decode_roofline"] = roof
+        print(json.dumps(perf_report.annotate(rec, provenance="fresh")),
+              flush=True)
 
     timed(True)
     if not args.skip_refeed:
@@ -103,14 +118,22 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         jax.block_until_ready(spec())
         dt = time.perf_counter() - t0
-        print(json.dumps(perf_report.annotate({
+        rec = {
             "metric": f"{args.model}_decode_tokens_per_sec",
             "mode": f"speculative_selfdraft_k{args.draft_len}",
             "value": round(args.new_tokens / dt, 1),
             "unit": "tokens/sec", "batch": 1,
             "prompt_len": args.prompt_len, "new_tokens": args.new_tokens,
             "wall_s": round(dt, 2), "compile_s": round(compile_s, 1),
-        }, provenance="fresh")), flush=True)
+        }
+        roof = flopslib.decode_roofline(
+            args.model, context_len=mid_context,
+            tokens_per_sec=rec["value"] / jax.device_count(),
+            device_kind=device_kind, batch=1)
+        if roof:
+            rec["decode_roofline"] = roof
+        print(json.dumps(perf_report.annotate(rec, provenance="fresh")),
+              flush=True)
     return 0
 
 

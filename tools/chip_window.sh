@@ -265,6 +265,19 @@ timeout 600 python tools/bench_generate.py --model gpt2_small --batch 8 \
   2>> "$RES/log.txt"
 note decode
 
+# 10b. Continuous-batching serve bench (gated, ask with DDL_SERVE=1): the
+# paged-KV engine vs sequential generate() under the same Poisson load,
+# on the real chip. Gated because the sequential baseline arm deliberately
+# saturates and its cost scales with --requests; the record (speedup,
+# TTFT/ITL percentiles, decode roofline) lands in serve_throughput.json
+# and the last_serve sidecar for doctor.py.
+if [ "${DDL_SERVE:-0}" = "1" ]; then
+  check_stop serve
+  timeout 600 python tools/bench_serve.py --dtype bfloat16 \
+    > "$RES/serve_throughput.json" 2>> "$RES/log.txt"
+  note serve
+fi
+
 check_stop flash
 # 11. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
 timeout 600 python tools/validate_flash_tpu.py \

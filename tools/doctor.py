@@ -321,6 +321,35 @@ def check_ddl_lint() -> None:
         emit("ddl_lint", ok=True, error=str(e)[:200])
 
 
+def check_serve() -> None:
+    """Last continuous-batching serve bench (tools/bench_serve.py drops
+    the last_serve sidecar): tokens/sec/chip, speedup over the sequential
+    generate() baseline, TTFT p50/p99 and AOT executable sources — so
+    "what did serving last measure?" is answerable from doctor output.
+    ok=True always: an absent sidecar just means the bench has not run."""
+    try:
+        from distributeddeeplearning_tpu.observability import sidecars
+        side = sidecars.read("last_serve")
+        if side is None:
+            emit("serve", ok=True, last_bench=None,
+                 note="no last_serve sidecar; run python tools/"
+                      "bench_serve.py")
+            return
+        rec = side.get("record") or {}
+        cont = rec.get("continuous") or {}
+        age = sidecars.age_s(side)
+        emit("serve", ok=True,
+             tokens_per_sec_per_chip=rec.get("value"),
+             speedup_vs_sequential=rec.get("speedup_vs_sequential"),
+             ttft_s=cont.get("ttft_s"),
+             preemptions=cont.get("preemptions"),
+             model=rec.get("model"), provenance=rec.get("provenance"),
+             aot_sources=(rec.get("aot") or {}).get("sources"),
+             age_s=round(age, 1) if age is not None else None)
+    except Exception as e:
+        emit("serve", ok=True, error=str(e)[:200])
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--probe-timeout", type=int, default=45)
@@ -340,6 +369,7 @@ def main(argv=None) -> int:
     check_elastic()
     check_flight()
     check_ddl_lint()
+    check_serve()
     return 0
 
 

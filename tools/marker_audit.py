@@ -170,21 +170,57 @@ def audit_lint(records) -> list[str]:
     return problems
 
 
+def audit_serve(records) -> list[str]:
+    """Problems with serve-engine coverage in this run.
+
+    The continuous-batching engine (tests marked ``serve``) has the same
+    silent-disarm failure modes: the marked tests vanish from the
+    selection, or every one is also marked ``slow`` and tier-1's
+    ``-m 'not slow'`` stops pinning engine token-identity against
+    sequential generate(). The serve_decode perf-gate workload
+    (tests/test_perf_gate.py) must also have run — losing it quietly
+    un-gates the engine's per-step cost."""
+    problems = []
+    serve = [r for r in records if r.get("serve")]
+    if not serve:
+        problems.append(
+            "no serve-marked test ran — the continuous-batching engine is "
+            "untested in this run (tests/test_serve.py missing, renamed, "
+            "or deselected?)")
+    elif all(r.get("slow") for r in serve):
+        problems.append(
+            "every serve-marked test is also marked slow — tier-1 runs "
+            "-m 'not slow', so engine token-identity is silently unpinned "
+            "in tier-1 (keep a fast serve variant unmarked)")
+    if not any(r.get("perf_gate") and "serve_decode" in (r.get("nodeid")
+                                                         or "")
+               for r in records):
+        problems.append(
+            "no perf_gate test covering the serve_decode workload ran — "
+            "the engine's decode-step cost is ungated "
+            "(tests/test_perf_gate.py::test_perf_gate_live_serve_decode "
+            "missing, renamed, or deselected?)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
               f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
-              f"[--expect-elastic] [--expect-flight] [--expect-lint]")
+              f"[--expect-elastic] [--expect-flight] [--expect-lint] "
+              f"[--expect-serve]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
     expect_elastic = "--expect-elastic" in argv
     expect_flight = "--expect-flight" in argv
     expect_lint = "--expect-lint" in argv
+    expect_serve = "--expect-serve" in argv
     argv = [a for a in argv
             if a not in ("--expect-perf-gate", "--expect-elastic",
-                         "--expect-flight", "--expect-lint")]
+                         "--expect-flight", "--expect-lint",
+                         "--expect-serve")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -212,6 +248,9 @@ def main(argv=None) -> int:
     # ddl-lint gate coverage likewise (presence + registration checks).
     if expect_lint:
         gate_problems += audit_lint(records)
+    # Serve-engine coverage likewise (presence + serve_decode gate checks).
+    if expect_serve:
+        gate_problems += audit_serve(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

@@ -19,6 +19,11 @@ cannot quietly regress it:
   a ``"metric"`` key) carries a ``perf_report.annotate`` provenance stamp
   — PR 6's rule that perf claims are dated, attributed, and
   staleness-graded or they don't exist.
+- ``page-table-log-before-dispatch``: a serve-engine function that
+  stores into a KV ``page_table`` subscript and then launches a
+  prefill/decode program must put a flight ``record(...)`` between the
+  mutation and the dispatch — the page table is the map to pool state
+  a crashed replica cannot otherwise reconstruct.
 - ``axis-name-consistency``: string axis names at ``psum`` /
   ``psum_scatter`` / ``all_gather`` / ``pmean`` / ... call sites must be
   declared in ``parallel/mesh.py``'s ``MESH_AXES`` — a typo'd axis name
@@ -232,6 +237,67 @@ def check_perf_record_provenance(tree: ast.Module, path: str) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# page-table-log-before-dispatch
+# ---------------------------------------------------------------------------
+
+_PAGE_TABLE_NAMES = ("_page_table", "page_table")
+
+
+def check_page_table_log_before_dispatch(tree: ast.Module,
+                                         path: str) -> list[dict]:
+    """A serve-engine page-table mutation must hit the flight record
+    before the step that consumes it dispatches.
+
+    The page table is the one piece of engine state a post-mortem cannot
+    reconstruct after a crash (pool contents die with the process, the
+    table is the map to them). The serve-chaos PR's convention: any
+    function that stores into a ``page_table``/``_page_table`` subscript
+    and then launches a prefill/decode program must ``record(...)``
+    between the mutation and the dispatch — otherwise a replica killed
+    inside that program leaves a flight record that never mentions the
+    mutation the dying step was built on."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stores: list[int] = []
+        records: list[int] = []
+        dispatches: list[int] = []
+        for sub in _shallow_walk(node):
+            if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _terminal_name(t.value) \
+                            in _PAGE_TABLE_NAMES:
+                        stores.append(sub.lineno)
+            elif isinstance(sub, ast.Call):
+                name = _terminal_name(sub.func)
+                if name == "record":
+                    records.append(sub.lineno)
+                elif name is not None and ("prefill" in name.lower()
+                                           or "decode" in name.lower()):
+                    dispatches.append(sub.lineno)
+        for d in sorted(dispatches):
+            prior = [s for s in stores if s < d]
+            if not prior:
+                continue
+            if not any(min(prior) <= r < d for r in records):
+                findings.append(finding(
+                    "lints", "page-table-log-before-dispatch",
+                    f"{node.name}() mutates the KV page table (line "
+                    f"{max(prior)}) and dispatches a prefill/decode "
+                    f"program (line {d}) with no flight record in "
+                    f"between — a replica killed inside that program "
+                    f"leaves no durable trace of the mapping the dying "
+                    f"step was built on",
+                    file=path, line=d))
+                break  # one finding per function tells the story
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # axis-name-consistency
 # ---------------------------------------------------------------------------
 
@@ -325,7 +391,8 @@ def check_axis_names(tree: ast.Module, path: str,
 # ---------------------------------------------------------------------------
 
 _CHECKS = (check_sidecar_writes, check_fsync_before_fire,
-           check_unpaired_spans, check_perf_record_provenance)
+           check_unpaired_spans, check_perf_record_provenance,
+           check_page_table_log_before_dispatch)
 
 
 def analyze_source(src: str, path: str = "<memory>", *,

@@ -639,6 +639,374 @@ def run_from_hostfile(path: str, process_id: int, command: Sequence[str], *,
     return monitor([child])
 
 
+# ---------------------------------------------------------------------------
+# Serve mode: replica supervision with token-identical re-dispatch
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(replica: int, num_replicas: int, workdir: str, *,
+                   attempt: int, heartbeat_dir: Optional[str],
+                   fault_plan: Optional[str]) -> subprocess.Popen:
+    """One serve replica process. Heartbeat/flight identity reuse the
+    training child conventions (``DDL_PROCESS_ID`` names both files); no
+    coordinator is exported — replicas are independent model copies, not
+    ranks of one mesh."""
+    env = dict(os.environ)
+    env[ENV_PROCESS_ID] = str(replica)
+    env[ENV_NUM_PROCESSES] = str(num_replicas)
+    env.pop(ENV_COORDINATOR, None)
+    env[faults.ENV_ATTEMPT] = str(attempt)
+    if fault_plan:
+        env[faults.ENV_PLAN] = fault_plan
+    else:
+        env.pop(faults.ENV_PLAN, None)
+    if heartbeat_dir is not None:
+        env[health.ENV_HEARTBEAT_DIR] = heartbeat_dir
+        # A restarted replica must not inherit its predecessor's last
+        # heartbeat: stale mtimes would mask a hang.
+        try:
+            os.remove(health.heartbeat_path(heartbeat_dir, replica))
+        except OSError:
+            pass
+    command = [sys.executable, "-m",
+               "distributeddeeplearning_tpu.serve.replica",
+               "--workdir", workdir, "--replica", str(replica)]
+    return subprocess.Popen(command, env=env)
+
+
+def _dispatch_request(workdir: str, replica: int, attempt: int,
+                      payload: dict) -> None:
+    """Atomically drop one request file into a replica's inbox. The inbox
+    is per (replica, attempt): a warm-restarted replica must not replay
+    its predecessor's inbox — those victims were re-dispatched already."""
+    inbox = os.path.join(workdir, "inbox", f"r{replica}.a{attempt}")
+    os.makedirs(inbox, exist_ok=True)
+    name = f"req-{payload['uid']:06d}-{payload.get('dispatch', 0)}.json"
+    tmp = os.path.join(inbox, name + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, os.path.join(inbox, name))
+
+
+def run_serve(num_replicas: int, requests: Sequence[dict],
+              serve_config: dict, *, workdir: str,
+              heartbeat_dir: Optional[str] = None,
+              heartbeat_timeout_s: float = 0.0,
+              max_restarts: int = 1, max_request_retries: int = 3,
+              child_fault_plans: Optional[dict] = None,
+              flight_dir: Optional[str] = None,
+              poll_interval_s: float = 0.05,
+              timeout_s: float = 600.0,
+              clock: Callable[[], float] = time.monotonic) -> dict:
+    """Supervise N serve-engine replicas over one request trace.
+
+    The serving analogue of ``run_local`` + ``run_with_restarts``, with one
+    structural difference: a training job fails whole (every rank computes
+    the same update), but replicas are independent — one dying must NOT
+    tear the others down. Instead its in-flight requests are re-dispatched
+    to survivors with the token prefix the supervisor already received
+    folded into the prompt, so the completed stream is token-identical to
+    an uninterrupted run (greedy prefix-folding, the same path preemption
+    resume uses). The dead replica is restarted warm (shared AOT
+    executable cache via ``config.json``) under a per-replica restart
+    budget, with ``DDL_RESTART_ATTEMPT`` bumped so attempt-scoped faults
+    do not re-fire.
+
+    ``requests``: dicts with ``prompt``/``max_new_tokens`` (+ optional
+    ``tenant``/``arrival_s`` relative to the run start). Returns per-uid
+    results plus the incident/restart accounting; the flight record gets
+    the full chain (``serve_replica_lost`` -> ``serve_redispatch`` ->
+    ``serve_replayed``) for ``tools/postmortem.py``.
+    """
+    if num_replicas < 1:
+        raise ValueError(f"num_replicas={num_replicas}: need >= 1")
+    os.makedirs(workdir, exist_ok=True)
+    if heartbeat_dir is not None:
+        os.makedirs(heartbeat_dir, exist_ok=True)
+    with open(os.path.join(workdir, "config.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(dict(serve_config), f, indent=2, sort_keys=True)
+
+    if flight_dir is not None:
+        os.environ[flightlib.ENV_FLIGHT_DIR] = flight_dir
+        os.environ.setdefault(flightlib.ENV_RUN_ID, flightlib.mint_run_id())
+        flightlib.configure(flight_dir,
+                            run_id=os.environ[flightlib.ENV_RUN_ID],
+                            host="launcher")
+    flight = flightlib.get()
+    flight.record("serve_launch", num_replicas=num_replicas,
+                  requests=len(requests), max_restarts=max_restarts)
+
+    plans = dict(child_fault_plans or {})
+    for plan in plans.values():
+        faults.parse_plan(plan)  # fail fast on grammar errors
+
+    reqs: dict[int, dict] = {}
+    for i, d in enumerate(requests):
+        uid = int(d.get("uid", i))
+        reqs[uid] = {
+            "tenant": d.get("tenant", "default"),
+            "prompt": [int(t) for t in d["prompt"]],
+            "max_new": int(d["max_new_tokens"]),
+            "arrival_s": float(d.get("arrival_s", 0.0)),
+            "tokens": [], "replica": None, "dispatched": False,
+            "finished": False, "failed": None, "retries": 0,
+            "dispatches": 0, "first_token_t": None,
+        }
+
+    reps: list[dict] = []
+    for i in range(num_replicas):
+        proc = _spawn_replica(i, num_replicas, workdir, attempt=0,
+                              heartbeat_dir=heartbeat_dir,
+                              fault_plan=plans.get(i))
+        reps.append({"proc": proc, "alive": True, "attempt": 0,
+                     "restarts": 0, "ever_beat": False, "hung": False,
+                     "last_step": 0, "offset": 0, "rc": None,
+                     "drained": False})
+        flight.record("spawn", child=i, pid=proc.pid, scope="serve")
+
+    redispatched = 0
+    total_restarts = 0
+    stopping = False
+    t0 = clock()
+
+    def closed(st: dict) -> bool:
+        return st["finished"] or st["failed"] is not None
+
+    def drain_events(rid: int) -> None:
+        rep = reps[rid]
+        path = os.path.join(workdir, "events", f"r{rid}.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(rep["offset"])
+                blob = f.read()
+        except OSError:
+            return
+        cut = blob.rfind(b"\n")
+        if cut < 0:
+            return
+        rep["offset"] += cut + 1
+        for line in blob[:cut + 1].splitlines():
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            kind = e.get("ev")
+            if "step" in e:
+                rep["last_step"] = max(rep["last_step"], int(e["step"]))
+            if kind == "token":
+                st = reqs.get(int(e["uid"]))
+                if st is not None and st["replica"] == rid \
+                        and not closed(st):
+                    if st["first_token_t"] is None:
+                        st["first_token_t"] = clock()
+                    st["tokens"].extend(int(t) for t in e["tokens"])
+            elif kind == "finished":
+                st = reqs.get(int(e["uid"]))
+                if st is not None and st["replica"] == rid:
+                    st["finished"] = True
+                    if st["retries"]:
+                        flight.record("serve_replayed",
+                                      request=int(e["uid"]), replica=rid,
+                                      tokens=len(st["tokens"]),
+                                      retries=st["retries"],
+                                      token_identical=True)
+            elif kind == "failed":
+                st = reqs.get(int(e["uid"]))
+                if st is not None and st["replica"] == rid:
+                    st["failed"] = e.get("reason", "unknown")
+            elif kind == "drained":
+                rep["drained"] = True
+
+    def on_replica_death(rid: int, rc: int) -> None:
+        nonlocal redispatched, total_restarts
+        rep = reps[rid]
+        rep["alive"], rep["rc"] = False, rc
+        drain_events(rid)  # salvage everything the OS buffered
+        if rc == 0 and rep["drained"]:
+            return  # clean drain after the stop sentinel
+        label = attribute_failure(heartbeat_dir, rid, hung=rep["hung"],
+                                  ever_beat=rep["ever_beat"])
+        victims = [uid for uid, st in reqs.items()
+                   if st["replica"] == rid and st["dispatched"]
+                   and not closed(st)]
+        flight.record("child_exit", child=rid, rc=rc, attribution=label,
+                      scope="serve")
+        flight.record("serve_replica_lost", replica=rid, rc=rc,
+                      step=rep["last_step"], attribution=label,
+                      inflight=len(victims))
+        print(f"# launcher: serve replica {rid} lost at engine step "
+              f"{rep['last_step']} (rc={rc}, {label}); "
+              f"{len(victims)} in-flight request(s) to re-dispatch",
+              file=sys.stderr, flush=True)
+        for uid in victims:
+            st = reqs[uid]
+            st["replica"], st["dispatched"] = None, False
+            if len(st["tokens"]) >= st["max_new"]:
+                # Fully streamed; only the 'finished' line was lost.
+                st["finished"] = True
+                continue
+            st["retries"] += 1
+            if st["retries"] > max_request_retries:
+                st["failed"] = "retries_exhausted"
+                flight.record("serve_shed", request=uid,
+                              reason="retries_exhausted", scope="serve")
+            else:
+                redispatched += 1
+        if rep["restarts"] < max_restarts and not stopping:
+            rep["restarts"] += 1
+            rep["attempt"] += 1
+            total_restarts += 1
+            flight.record("restart", child=rid, attempt=rep["attempt"],
+                          scope="serve")
+            rep["proc"] = _spawn_replica(
+                rid, num_replicas, workdir, attempt=rep["attempt"],
+                heartbeat_dir=heartbeat_dir, fault_plan=plans.get(rid))
+            rep["alive"], rep["hung"], rep["rc"] = True, False, None
+
+    try:
+        while True:
+            now = clock()
+            alive = [i for i, r in enumerate(reps) if r["alive"]]
+            # Dispatch due requests round-robin over live replicas; a
+            # re-dispatched victim carries its received prefix.
+            if alive:
+                for uid in sorted(reqs):
+                    st = reqs[uid]
+                    if (st["dispatched"] or closed(st)
+                            or now - t0 < st["arrival_s"]):
+                        continue
+                    rid = alive[st["dispatches"] % len(alive)]
+                    rep = reps[rid]
+                    payload = {"uid": uid, "tenant": st["tenant"],
+                               "prompt": st["prompt"],
+                               "max_new_tokens": st["max_new"],
+                               "prefix": list(st["tokens"]),
+                               "dispatch": st["dispatches"]}
+                    _dispatch_request(workdir, rid, rep["attempt"], payload)
+                    st["replica"], st["dispatched"] = rid, True
+                    st["dispatches"] += 1
+                    if st["retries"]:
+                        flight.record("serve_redispatch", request=uid,
+                                      to=rid, resumed_from=len(st["tokens"]),
+                                      retries=st["retries"])
+            for rid in range(num_replicas):
+                if reps[rid]["alive"]:
+                    drain_events(rid)
+            if heartbeat_dir is not None:
+                for rid in range(num_replicas):
+                    rep = reps[rid]
+                    if rep["alive"] and not rep["ever_beat"]:
+                        rep["ever_beat"] = os.path.exists(
+                            health.heartbeat_path(heartbeat_dir, rid))
+                if heartbeat_timeout_s > 0:
+                    beat_set = {i for i, r in enumerate(reps)
+                                if r["alive"] and r["ever_beat"]}
+                    for pid, age in health.check_stale(
+                            heartbeat_dir, num_replicas,
+                            heartbeat_timeout_s):
+                        if pid in beat_set and not reps[pid]["hung"]:
+                            reps[pid]["hung"] = True
+                            flight.record("heartbeat_stale", child=pid,
+                                          age_s=round(age, 3), scope="serve")
+                            reps[pid]["proc"].kill()
+            for rid in range(num_replicas):
+                rep = reps[rid]
+                if rep["alive"]:
+                    rc = rep["proc"].poll()
+                    if rc is not None:
+                        on_replica_death(rid, rc)
+            if all(closed(st) for st in reqs.values()):
+                if not stopping:
+                    stopping = True
+                    for rid in range(num_replicas):
+                        with open(os.path.join(workdir, f"stop.r{rid}"),
+                                  "w", encoding="utf-8") as f:
+                            f.write("drain\n")
+                if not any(r["alive"] for r in reps):
+                    break
+            if now - t0 > timeout_s:
+                raise RuntimeError(
+                    f"serve supervision timed out after {timeout_s:.0f}s: "
+                    f"{sum(1 for s in reqs.values() if not closed(s))} "
+                    f"request(s) open, replicas alive="
+                    f"{[i for i, r in enumerate(reps) if r['alive']]}")
+            time.sleep(poll_interval_s)
+    finally:
+        for rep in reps:
+            if rep["alive"]:
+                rep["proc"].kill()
+                rep["proc"].wait()
+
+    # The drain gate: a replica that reaches its stop sentinel runs the
+    # engine's shutdown leak check and exits 0 only if page accounting
+    # balanced — so "every replica drained AND exited 0" IS the leak
+    # check. A replica that died in shutdown (leak found) has rc != 0 and
+    # no drained event; both must fail this.
+    leak_check_ok = bool(reps) and all(
+        r["rc"] == 0 and r["drained"] for r in reps)
+    window_s = clock() - t0
+    flight.record("serve_drained", window_s=round(window_s, 3),
+                  redispatched=redispatched, restarts=total_restarts,
+                  leak_check_ok=leak_check_ok)
+    results = {}
+    for uid, st in reqs.items():
+        ttft = None
+        if st["first_token_t"] is not None:
+            ttft = max(0.0, st["first_token_t"] - (t0 + st["arrival_s"]))
+        results[uid] = {"tokens": list(st["tokens"]),
+                        "finished": st["finished"],
+                        "failed": st["failed"],
+                        "retries": st["retries"], "ttft_s": ttft}
+    return {"results": results, "redispatched": redispatched,
+            "restarts": total_restarts, "window_s": window_s,
+            "leak_check_ok": leak_check_ok,
+            "replica_rcs": {i: r["rc"] for i, r in enumerate(reps)}}
+
+
+def _main_serve(args, p) -> int:
+    """CLI shim for serve mode: files in, run_serve, summary out."""
+    import tempfile
+
+    with open(args.serve, encoding="utf-8") as f:
+        requests = json.load(f)
+    if not isinstance(requests, list) or not requests:
+        p.error(f"--serve {args.serve}: expected a non-empty JSON list")
+    with open(args.serve_config, encoding="utf-8") as f:
+        serve_config = json.load(f)
+
+    plans: dict[int, str] = {}
+    for item in args.child_fault_plan:
+        idx_s, sep, plan = item.partition(":")
+        if not sep or not idx_s.isdigit():
+            p.error(f"--child-fault-plan expects IDX:PLAN, got {item!r}")
+        plans[int(idx_s)] = plan
+
+    workdir = args.serve_dir or tempfile.mkdtemp(prefix="ddl-serve-")
+    # Heartbeats are always on in serve mode: attribution (hung vs crash
+    # vs host_lost) needs ever_beat even when the staleness watchdog is
+    # disabled.
+    heartbeat_dir = args.heartbeat_dir or tempfile.mkdtemp(
+        prefix="ddl-serve-hb-")
+
+    out = run_serve(args.num_processes or 1, requests, serve_config,
+                    workdir=workdir, heartbeat_dir=heartbeat_dir,
+                    heartbeat_timeout_s=args.heartbeat_timeout,
+                    max_restarts=args.max_restarts,
+                    child_fault_plans=plans, flight_dir=args.flight_dir)
+    if args.serve_out:
+        with open(args.serve_out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2, sort_keys=True, default=str)
+    done = sum(1 for r in out["results"].values() if r["finished"])
+    print(f"# launcher: serve drained — {done}/{len(out['results'])} "
+          f"finished, {out['redispatched']} re-dispatched, "
+          f"{out['restarts']} restart(s), leak check "
+          f"{'ok' if out['leak_check_ok'] else 'FAILED'} "
+          f"({out['window_s']:.1f}s)", flush=True)
+    ok = out["leak_check_ok"] and all(
+        r["finished"] for r in out["results"].values())
+    return 0 if ok else 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -709,6 +1077,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "every restart attempt (docs/compile_cache.md); "
                         "default $DDL_COMPILE_CACHE or the repo-local "
                         ".cache/jax_compile; 'off' disables")
+    p.add_argument("--serve", default=None, metavar="REQUESTS.json",
+                   help="serve mode: supervise --num-processes engine "
+                        "replicas over this request trace (list of "
+                        "{prompt, max_new_tokens[, tenant, arrival_s]}) "
+                        "instead of launching a training command. Replicas "
+                        "lost mid-decode have their in-flight requests "
+                        "re-dispatched to survivors token-identically; "
+                        "--max-restarts / --heartbeat-timeout / "
+                        "--child-fault-plan / --flight-dir apply per "
+                        "replica (docs/serving.md)")
+    p.add_argument("--serve-config", default=None, metavar="CONFIG.json",
+                   help="ServeConfig fields for serve mode (required with "
+                        "--serve)")
+    p.add_argument("--serve-dir", default=None,
+                   help="serve-mode work directory for the inbox/event "
+                        "files (default: a fresh temp dir)")
+    p.add_argument("--serve-out", default=None,
+                   help="write the serve-mode result summary (per-request "
+                        "tokens, re-dispatch/restart accounting, leak "
+                        "check) to this JSON file")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -716,6 +1104,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
+    if args.serve is not None:
+        if command:
+            p.error("--serve replaces the training command; drop the "
+                    "trailing command")
+        if args.hostfile or args.elastic:
+            p.error("--serve only supports local (--num-processes) jobs")
+        if args.serve_config is None:
+            p.error("--serve requires --serve-config")
+        return _main_serve(args, p)
     if not command:
         p.error("no training command given (pass it after `--`)")
 

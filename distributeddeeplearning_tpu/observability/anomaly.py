@@ -16,6 +16,15 @@ the log cadence and flags four families:
     straggler_trending                   one host slow for N intervals
     bad_step                             the compiled guard tripped
 
+Serve-side signals (fed by the replica / bench on the same cadence via
+``update_serve()``):
+
+    queue_blowup                         wait queue far above its rolling
+                                         median — admission has stalled
+    shed_storm                           brownout shedding in bulk
+    deadline_miss_rate                   a large fraction of completions
+                                         are deadline misses
+
 Design constraints, in order:
 
 1. **Zero false positives on a clean run.** Baselines are rolling
@@ -66,7 +75,11 @@ class AnomalyDetector:
                  throughput_collapse_frac: float = 0.35,
                  data_wait_dominance: float = 0.6,
                  straggler_ratio: float = 1.5,
-                 straggler_patience: int = 3):
+                 straggler_patience: int = 3,
+                 queue_blowup_factor: float = 4.0,
+                 queue_floor: int = 4,
+                 shed_storm_min: int = 3,
+                 deadline_miss_threshold: float = 0.25):
         self.min_samples = int(min_samples)
         self.loss_margin = float(loss_margin)
         self.loss_mad_k = float(loss_mad_k)
@@ -75,9 +88,14 @@ class AnomalyDetector:
         self.data_wait_dominance = float(data_wait_dominance)
         self.straggler_ratio = float(straggler_ratio)
         self.straggler_patience = int(straggler_patience)
+        self.queue_blowup_factor = float(queue_blowup_factor)
+        self.queue_floor = int(queue_floor)
+        self.shed_storm_min = int(shed_storm_min)
+        self.deadline_miss_threshold = float(deadline_miss_threshold)
         self._loss: deque = deque(maxlen=window)
         self._grad: deque = deque(maxlen=window)
         self._eps: deque = deque(maxlen=window)
+        self._queue: deque = deque(maxlen=window)
         self._straggler_streak = 0
 
     def update(self, step: int, *, loss: Any = None, grad_norm: Any = None,
@@ -165,6 +183,62 @@ class AnomalyDetector:
             if b is not None and b > 0:
                 flag("bad_step", b, 0.0,
                      "compiled bad-step guard skipped a non-finite update")
+
+        return out
+
+    def update_serve(self, step: int, *, queue_depth: Any = None,
+                     sheds: Any = None, deadline_misses: Any = None,
+                     finished: Any = None) -> list[dict]:
+        """Feed one serve-cadence observation; returns flagged anomalies.
+
+        ``queue_depth`` is the instantaneous wait-queue length;
+        ``sheds``/``deadline_misses``/``finished`` are counts *for this
+        interval* (the caller diffs the engine's cumulative counters).
+        Same zero-false-positive discipline as ``update()``: queue depth
+        judges against its own rolling median behind an absolute floor
+        and ``min_samples``; the storm/rate kinds need real volume before
+        they can fire, so a healthy engine never trips them."""
+        out: list[dict] = []
+
+        def flag(kind: str, value: Any, baseline: Any, detail: str) -> None:
+            out.append({"kind": kind, "step": int(step),
+                        "value": value, "baseline": baseline,
+                        "detail": detail})
+
+        if queue_depth is not None:
+            q = _finite(queue_depth)
+            if q is not None:
+                if len(self._queue) >= self.min_samples:
+                    med = median(self._queue)
+                    limit = max(float(self.queue_floor),
+                                self.queue_blowup_factor * max(med, 1.0))
+                    if q > limit:
+                        flag("queue_blowup", q, med,
+                             f"wait queue {q:.0f} deep vs rolling median "
+                             f"{med:.0f} (limit {limit:.0f}) — admission "
+                             "has stalled or arrivals outrun decode")
+                self._queue.append(q)
+
+        if sheds is not None:
+            s = _finite(sheds)
+            if s is not None and s >= self.shed_storm_min:
+                flag("shed_storm", s, float(self.shed_storm_min),
+                     f"brownout shed {s:.0f} request(s) in one interval — "
+                     "the pool or queue is pressured enough to drop work "
+                     "in bulk")
+
+        if deadline_misses is not None:
+            m = _finite(deadline_misses)
+            done = _finite(finished) if finished is not None else None
+            total = (m or 0.0) + (done or 0.0)
+            if (m is not None and m > 0 and total >= self.min_samples
+                    and m / total >= self.deadline_miss_threshold):
+                flag("deadline_miss_rate", m / total,
+                     self.deadline_miss_threshold,
+                     f"{m:.0f} of {total:.0f} completions this interval "
+                     f"missed their deadline "
+                     f"({m / total:.0%} >= "
+                     f"{self.deadline_miss_threshold:.0%})")
 
         return out
 

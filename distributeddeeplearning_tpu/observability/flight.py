@@ -58,7 +58,9 @@ DEFAULT_MAX_BYTES = 4 * 1024 * 1024
 
 # Event kinds a post-mortem treats as "something went wrong".
 INCIDENT_EVENTS = ("fault", "anomaly", "child_exit", "heartbeat_stale",
-                   "preempted", "abort", "giving_up")
+                   "preempted", "abort", "giving_up",
+                   "serve_replica_lost", "serve_shed",
+                   "serve_deadline_miss")
 
 
 def mint_run_id(now: Optional[float] = None) -> str:

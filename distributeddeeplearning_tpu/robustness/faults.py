@@ -48,6 +48,20 @@ Kinds
   degree. Fired from a *surviving* process — the dead host has no process
   to fire from.
 
+Serve-scoped kinds (fired at ``serve/engine.py`` step boundaries; ``crash``
+and ``sigkill`` are shared with training and mean the same thing there —
+the replica dies mid-decode):
+
+- ``page_leak@N``          — after engine step N, allocate one KV page and
+  drop it on the floor: held by the allocator, owned by no slot. The
+  engine's integrity check (``Engine.check_integrity``) must catch it at
+  the next step boundary and fail the replica loudly.
+- ``decode_stall@N[:Ts]``  — the decode of engine step N sleeps T seconds
+  (default 5) first; exercises deadline enforcement and the brownout path.
+- ``corrupt_page_table@N`` — after step N, scribble over a live slot's
+  host page-table row; the integrity check must detect the divergence
+  from the slot's owned pages before the corrupt row reaches a dispatch.
+
 Qualifiers (colon-separated, any order): ``aK`` — fire only on restart
 attempt K (the launcher's ``run_with_restarts`` exports the attempt index as
 ``DDL_RESTART_ATTEMPT``); ``always`` — fire on every attempt; ``<float>s`` —
@@ -78,12 +92,20 @@ ALWAYS = -1  # Fault.attempt sentinel: fire on every restart attempt
 KINDS = frozenset({
     "crash", "sigterm", "sigkill", "nan_grads", "loader_stall",
     "corrupt_latest_ckpt", "host_lost", "host_rejoin",
+    "page_leak", "decode_stall", "corrupt_page_table",
 })
 # Faults the train loop fires between steps (vs nan_grads: compiled into the
 # step; loader_stall: injected into the data source).
 _PROCESS_KINDS = frozenset({
     "crash", "sigterm", "sigkill", "corrupt_latest_ckpt",
     "host_lost", "host_rejoin"})
+# Faults the serve engine understands. crash/sigkill are shared with
+# training; the rest only make sense against a live engine.
+SERVE_KINDS = frozenset({
+    "crash", "sigkill", "page_leak", "decode_stall", "corrupt_page_table"})
+# Serve faults fired at the step boundary (vs decode_stall: injected into
+# the step itself, before the decode dispatch).
+_SERVE_BOUNDARY_KINDS = SERVE_KINDS - {"decode_stall"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,6 +211,21 @@ class FaultPlan:
         return tuple(f for f in self.faults
                      if f.step == step and f.kind in _PROCESS_KINDS)
 
+    def serve_stalls(self) -> dict[int, float]:
+        """engine step -> stall seconds injected before that step's decode."""
+        return {f.step: f.seconds for f in self.faults
+                if f.kind == "decode_stall"}
+
+    def serve_faults_at(self, step: int) -> tuple[Fault, ...]:
+        """Serve boundary faults at engine ``step``, in plan order (leak-
+        then-kill is not kill-then-leak)."""
+        return tuple(f for f in self.faults
+                     if f.step == step and f.kind in _SERVE_BOUNDARY_KINDS)
+
+    @property
+    def has_serve_boundary_faults(self) -> bool:
+        return any(f.kind in _SERVE_BOUNDARY_KINDS for f in self.faults)
+
     @property
     def has_process_faults(self) -> bool:
         return any(f.kind in _PROCESS_KINDS for f in self.faults)
@@ -229,6 +266,26 @@ def resolve(config=None) -> FaultPlan:
     attempt = current_attempt()
     return FaultPlan(tuple(
         f for f in parts if f.attempt in (ALWAYS, attempt)))
+
+
+def resolve_serve(extra: Optional[str] = None) -> FaultPlan:
+    """The effective serve-side plan for this engine: an explicit plan text
+    (``Engine(fault_plan=...)`` / bench ``--chaos``) merged with
+    ``DDL_FAULT_PLAN`` (the supervisor's per-replica injection), filtered to
+    the current restart attempt and to serve-relevant kinds. Attempt scoping
+    is what makes a warm-restarted replica replay its victims clean: the
+    default attempt-0 fault does not re-fire under ``DDL_RESTART_ATTEMPT=1``.
+    """
+    parts: list[Fault] = []
+    if extra:
+        parts.extend(parse_plan(extra))
+    env_text = os.environ.get(ENV_PLAN)
+    if env_text:
+        parts.extend(parse_plan(env_text))
+    attempt = current_attempt()
+    return FaultPlan(tuple(
+        f for f in parts
+        if f.attempt in (ALWAYS, attempt) and f.kind in SERVE_KINDS))
 
 
 def stream_guard_kwargs(config, *, train: bool = True) -> dict:
@@ -338,6 +395,63 @@ def _fire_one(fault: Fault, step: int, ckpt, checkpoint_dir) -> None:
         if ckpt is not None:
             ckpt.wait()
         raise SystemExit(f"fault injection: killed after step {step}")
+
+
+# ---------------------------------------------------------------------------
+# Serve-side injector (fired by Engine.step at its step boundaries)
+# ---------------------------------------------------------------------------
+
+def make_serve_injector(plan: FaultPlan, engine):
+    """A per-step callable firing the plan's serve boundary faults against
+    ``engine``, or None when the plan has none — a plan-free engine then
+    executes zero fault code per step (one ``is not None`` check), matching
+    the training loop's discipline."""
+    if not plan.has_serve_boundary_faults:
+        return None
+    steps_with_faults = {f.step for f in plan.faults
+                         if f.kind in _SERVE_BOUNDARY_KINDS}
+
+    def fire(step: int) -> None:
+        if step not in steps_with_faults:
+            return
+        for f in plan.serve_faults_at(step):
+            _fire_serve(f, step, engine)
+
+    return fire
+
+
+def _fire_serve(fault: Fault, step: int, engine) -> None:
+    import sys
+
+    from distributeddeeplearning_tpu.observability import flight
+
+    # Same fsync-before-fire discipline as _fire_one: the flight record is
+    # appended and fsync'd BEFORE the fault fires, so a sigkill'd replica
+    # still leaves an attributable record behind.
+    flight.get().record("fault", kind=fault.kind, step=step, scope="serve")
+    if fault.kind == "sigkill":
+        import signal
+        print(f"# fault injection: SIGKILL to serve replica after engine "
+              f"step {step}", file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.kind == "crash":
+        raise SystemExit(
+            f"fault injection: serve replica killed after engine step "
+            f"{step}")
+    elif fault.kind == "page_leak":
+        leaked = engine.allocator.alloc(1)
+        print(f"# fault injection: leaked KV page(s) {leaked} after engine "
+              f"step {step}", file=sys.stderr, flush=True)
+    elif fault.kind == "corrupt_page_table":
+        slot = engine.corrupt_page_table()
+        if slot is None:
+            print(f"# fault injection: corrupt_page_table@{step} ignored — "
+                  f"no live slot", file=sys.stderr, flush=True)
+        else:
+            print(f"# fault injection: corrupted page-table row of slot "
+                  f"{slot} after engine step {step}",
+                  file=sys.stderr, flush=True)
 
 
 def corrupt_latest_checkpoint(directory: str) -> Optional[int]:

@@ -30,8 +30,22 @@ deliberately out of scope here.
 
 Observability: per-request lifecycle events (``serve_admit`` /
 ``serve_prefill`` / ``serve_first_token`` / ``serve_retire`` /
-``serve_preempt``) go to the flight recorder; engine gauges (live slots,
-page occupancy, queue depth, TTFT) to ``observability/metrics.py``.
+``serve_preempt`` / ``serve_shed`` / ``serve_deadline_miss``) go to the
+flight recorder; engine gauges (live slots, page occupancy, queue depth,
+TTFT, shed/deadline-miss/retry counters) to ``observability/metrics.py``.
+
+Failure modes (docs/serving.md "Failure modes and recovery"): the engine
+accepts a serve fault plan (``robustness/faults.py`` grammar, resolved
+attempt-scoped from ``DDL_FAULT_PLAN``) and fires it at step boundaries —
+``crash``/``sigkill`` kill the replica mid-decode, ``decode_stall`` sleeps
+a step, ``page_leak``/``corrupt_page_table`` sabotage the paged-KV host
+state. Under an active plan every step opens with ``check_integrity()``
+(page-table rows vs owned pages vs allocator accounting), so sabotage is
+detected BEFORE the corrupt state reaches a dispatch; ``shutdown()`` runs
+the same gate unconditionally. Requests lost with a replica are replayed
+by the supervisor (``launch.run_serve``) through the same greedy
+prefix-folding path preemption uses, which is what makes recovery
+token-identical.
 """
 
 from __future__ import annotations
@@ -45,8 +59,10 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from distributeddeeplearning_tpu.robustness import faults as faultslib
 from distributeddeeplearning_tpu.serve import kv_cache
-from distributeddeeplearning_tpu.serve.scheduler import SloScheduler
+from distributeddeeplearning_tpu.serve.scheduler import (BrownoutController,
+                                                         SloScheduler)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +116,9 @@ class Request:
     itl_s: list = dataclasses.field(default_factory=list)
     finished_s: Optional[float] = None
     preemptions: int = 0
+    retries: int = 0            # re-admissions after preemption/loss
+    not_before_s: float = 0.0   # retry backoff: ineligible before this
+    failed: Optional[str] = None  # "deadline"/"shed"/"retries_exhausted"
     _last_emit_s: Optional[float] = None
 
     @property
@@ -138,6 +157,7 @@ class _SlotView(NamedTuple):
     tenant: str
     num_pages: int
     admitted_seq: int
+    arrival_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -158,7 +178,10 @@ class Engine:
 
     def __init__(self, config: ServeConfig, *, model=None, variables=None,
                  scheduler: Optional[SloScheduler] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 brownout: Optional[BrownoutController] = None,
+                 fault_plan: Optional[str] = None,
+                 stall: Optional[Callable[[float], None]] = None):
         import jax
         import jax.numpy as jnp
 
@@ -207,10 +230,24 @@ class Engine:
         self._slots: list = [None] * s
         self.waiting: collections.deque = collections.deque()
         self.finished: list = []
+        self.failed: list = []
         self._uid = 0
         self._admitted_seq = 0
         self.steps = 0
         self.preemptions = 0
+        self.sheds = 0
+        self.deadline_misses = 0
+        self.retries = 0
+
+        self.brownout = brownout
+        # Serve chaos: the resolved (attempt-scoped) plan installs a stall
+        # table and a boundary injector; a plan-free engine pays one
+        # ``is not None`` check per step and no integrity sweep.
+        plan = faultslib.resolve_serve(fault_plan)
+        self._stalls = plan.serve_stalls()
+        self._fault_fire = faultslib.make_serve_injector(plan, self)
+        self._chaos = bool(plan)
+        self._stall = stall or time.sleep
 
         self._aot = aotlib.StepExecutableCache(
             compile_cache.resolve_dir(cfg.compile_cache_dir),
@@ -262,18 +299,43 @@ class Engine:
         return not self.waiting and self.num_live == 0
 
     def step(self) -> list:
-        """One engine step: schedule, preempt, admit (+prefill), advance
+        """One engine step: schedule, expire/cancel deadline-blown work,
+        shed under brownout pressure, preempt, admit (+prefill), advance
         every live slot one token, retire finished. Returns the requests
-        that finished during this step."""
-        from distributeddeeplearning_tpu.observability import metrics
+        that finished during this step. Under an active fault plan the
+        step opens with an integrity sweep (sabotage from the previous
+        boundary must not reach a dispatch) and closes by firing the
+        injector."""
+        from distributeddeeplearning_tpu.observability import flight, metrics
 
+        if self._chaos:
+            self.check_integrity()
+        stall_s = self._stalls.get(self.steps + 1)
+        if stall_s:
+            flight.get().record("fault", kind="decode_stall",
+                                step=self.steps + 1, seconds=stall_s,
+                                scope="serve")
+            self._stall(stall_s)
         now = self._clock()
         finished_before = len(self.finished)
+        if self.brownout is not None:
+            for req in self.brownout.plan_shed(
+                    now=now, waiting=list(self.waiting),
+                    scheduler=self.scheduler,
+                    free_pages=self.allocator.free_pages,
+                    num_pages=self.config.num_pages):
+                self.waiting.remove(req)
+                self._fail(req, "shed", now)
         plan = self.scheduler.plan(
             now=now, waiting=list(self.waiting), live=self._slot_views(),
             free_slots=self.config.max_slots - self.num_live,
             free_pages=self.allocator.free_pages,
             page_size=self.config.page_size)
+        for slot in plan.cancel:
+            self._cancel(slot, now)
+        for req in plan.expire:
+            self.waiting.remove(req)
+            self._fail(req, "deadline", now)
         for slot in plan.preempt:
             self._preempt(slot, now)
         for req in plan.admit:
@@ -288,6 +350,12 @@ class Engine:
                     self.allocator.pages_in_use / self.config.num_pages,
                     step=self.steps)
         reg.observe("serve_queue_depth", len(self.waiting), step=self.steps)
+        reg.observe("serve_shed_total", self.sheds, step=self.steps)
+        reg.observe("serve_deadline_miss_total", self.deadline_misses,
+                    step=self.steps)
+        reg.observe("serve_retry_total", self.retries, step=self.steps)
+        if self._fault_fire is not None:
+            self._fault_fire(self.steps)
         return self.finished[finished_before:]
 
     def run_until_idle(self, *, max_steps: int = 10_000) -> list:
@@ -330,7 +398,8 @@ class Engine:
     def _slot_views(self) -> list:
         return [_SlotView(slot=i, tenant=s.request.tenant,
                           num_pages=len(s.pages),
-                          admitted_seq=s.admitted_seq)
+                          admitted_seq=s.admitted_seq,
+                          arrival_s=s.request.arrival_s)
                 for i, s in enumerate(self._slots) if s is not None]
 
     def _bucket_for(self, plen: int) -> int:
@@ -482,7 +551,11 @@ class Engine:
         entry = self._slots[slot]
         req = entry.request
         req.finished_s = now
-        self.allocator.free(entry.pages)
+        # release() + pages=[]: retirement is idempotent — a request that
+        # already walked a victim path cannot double-free (the one bug the
+        # strict free() exists to catch in non-victim paths).
+        self.allocator.release(entry.pages)
+        entry.pages = []
         self._clear_slot(slot)
         self.finished.append(req)
         flight.get().record("serve_retire", request=req.uid, slot=slot,
@@ -496,13 +569,53 @@ class Engine:
         req = entry.request
         req.preemptions += 1
         req._last_emit_s = None  # the gap back through the queue is not ITL
-        self.allocator.free(entry.pages)
+        self.allocator.release(entry.pages)
+        entry.pages = []
         self._clear_slot(slot)
-        self.waiting.append(req)
         self.preemptions += 1
         flight.get().record("serve_preempt", request=req.uid, slot=slot,
                             tenant=req.tenant,
                             tokens_done=len(req.tokens))
+        # Bounded retry with exponential backoff: the scheduler owns the
+        # policy, the engine applies it on every re-queue.
+        req.retries += 1
+        self.retries += 1
+        max_r = self.scheduler.max_retries
+        if max_r is not None and req.retries > max_r:
+            self._fail(req, "retries_exhausted", now)
+            return
+        delay = self.scheduler.retry_delay_s(req.retries)
+        if delay > 0:
+            req.not_before_s = now + delay
+        self.waiting.append(req)
+
+    def _cancel(self, slot: int, now: float) -> None:
+        """A live slot whose request blew its total-latency deadline:
+        return the slot and pages, fail the request as a deadline miss."""
+        entry = self._slots[slot]
+        req = entry.request
+        self.allocator.release(entry.pages)
+        entry.pages = []
+        self._clear_slot(slot)
+        self._fail(req, "deadline", now)
+
+    def _fail(self, req: Request, reason: str, now: float) -> None:
+        from distributeddeeplearning_tpu.observability import flight
+
+        req.failed = reason
+        req.finished_s = now
+        self.failed.append(req)
+        if reason == "deadline":
+            self.deadline_misses += 1
+            flight.get().record("serve_deadline_miss", request=req.uid,
+                                tenant=req.tenant,
+                                waited_s=round(now - req.arrival_s, 6),
+                                tokens_done=len(req.tokens))
+        else:
+            self.sheds += 1
+            flight.get().record("serve_shed", request=req.uid,
+                                tenant=req.tenant, reason=reason,
+                                tokens_done=len(req.tokens))
 
     def _clear_slot(self, slot: int) -> None:
         self._slots[slot] = None
@@ -510,3 +623,50 @@ class Engine:
         self._lengths[slot] = 0
         self._feed[slot, 0] = 0
         self._page_table[slot] = 0
+
+    # -- integrity / chaos hooks ------------------------------------------
+
+    def check_integrity(self) -> None:
+        """Reconcile the three views of page ownership — slot page-table
+        rows, slot owned-page lists, allocator accounting — and raise on
+        any divergence. Runs before every dispatch under an active fault
+        plan and unconditionally at shutdown: a leaked page starves
+        admission later; a corrupt row serves another slot's K/V now."""
+        owned: list = []
+        for i, entry in enumerate(self._slots):
+            if entry is None:
+                continue
+            row = [int(p) for p in self._page_table[i, :len(entry.pages)]]
+            pages = [int(p) for p in entry.pages]
+            if row != pages:
+                raise RuntimeError(
+                    f"page-table corruption: slot {i} row {row} != owned "
+                    f"pages {pages}")
+            owned.extend(pages)
+        self.allocator.check_leaks(owned)
+
+    def corrupt_page_table(self) -> Optional[int]:
+        """Fault-injection hook (``corrupt_page_table@N``): scribble over
+        the first live slot's page-table row. Returns the slot hit, or
+        None when nothing is live to corrupt."""
+        for i, entry in enumerate(self._slots):
+            if entry is not None and entry.pages:
+                self._page_table[i, 0] = (
+                    int(self._page_table[i, 0]) + 1) % self.config.num_pages
+                return i
+        return None
+
+    def shutdown(self) -> None:
+        """Final gate: flight-record the lifetime counters, then assert
+        page accounting balances (allocated == sum of live page tables).
+        Raises RuntimeError on a leak — a replica that leaks pages must
+        exit loudly, not report success."""
+        from distributeddeeplearning_tpu.observability import flight
+
+        flight.get().record("serve_shutdown", steps=self.steps,
+                            finished=len(self.finished),
+                            failed=len(self.failed),
+                            preemptions=self.preemptions,
+                            sheds=self.sheds,
+                            deadline_misses=self.deadline_misses)
+        self.check_integrity()

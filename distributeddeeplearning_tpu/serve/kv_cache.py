@@ -245,6 +245,12 @@ class PageAllocator:
         self._held.update(pages)
         return pages
 
+    @property
+    def held_pages(self) -> frozenset:
+        """Pages currently allocated — the ground truth the engine's
+        integrity check reconciles against the slots' page tables."""
+        return frozenset(self._held)
+
     def free(self, pages) -> None:
         for p in pages:
             if p not in self._held:
@@ -254,3 +260,42 @@ class PageAllocator:
                     f"both slots' K/V")
             self._held.discard(p)
             self._free.append(p)
+
+    def release(self, pages) -> int:
+        """Idempotent variant of ``free`` for victim retirement: frees only
+        the pages still held, silently skipping the rest, and returns how
+        many were actually returned. A request that was preempted (pages
+        freed, re-queued) and later shed/cancelled walks this path — its
+        second cleanup must be a no-op, not a double-free crash."""
+        freed = 0
+        for p in pages:
+            if p in self._held:
+                self._held.discard(p)
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def check_leaks(self, owned_pages) -> None:
+        """Raise unless allocator accounting balances exactly against the
+        pages owned by live slots: every held page is owned by exactly one
+        slot, every owned page is held, and free + held == num_pages. Called
+        at engine shutdown and after every chaos soak — a leak here means a
+        page was dropped on the floor (or double-owned) and the pool will
+        eventually starve admission."""
+        owned = list(owned_pages)
+        if len(owned) != len(set(owned)):
+            dupes = sorted({p for p in owned if owned.count(p) > 1})
+            raise RuntimeError(
+                f"page-table corruption: page(s) {dupes} appear on more "
+                f"than one live slot's table")
+        if set(owned) != self._held:
+            leaked = sorted(self._held - set(owned))
+            phantom = sorted(set(owned) - self._held)
+            raise RuntimeError(
+                f"KV page leak: allocator holds {sorted(self._held)} but "
+                f"live slots own {sorted(set(owned))} "
+                f"(leaked={leaked}, phantom={phantom})")
+        if len(self._free) + len(self._held) != self.num_pages:
+            raise RuntimeError(
+                f"allocator accounting broken: free={len(self._free)} + "
+                f"held={len(self._held)} != num_pages={self.num_pages}")

@@ -25,6 +25,23 @@ Policy, in the order it is applied:
    continuation deterministic, so no work is lost — tests pin
    token-identity across preemption). One per step bounds thrash; the
    next step re-evaluates.
+4. **Deadlines (opt-in).** A tenant may carry hard budgets on top of the
+   soft TTFT SLO: ``ttft_deadline_s`` (a waiting request that has not
+   produced its first token by then is expired rather than served
+   uselessly late) and ``total_deadline_s`` (a request — waiting or live —
+   past its total-latency budget is expired/cancelled, returning its slot
+   and pages). Both default to None: no enforcement, the PR-12 behavior.
+5. **Bounded retry with backoff.** A re-queued victim (preemption, replica
+   loss) is re-admitted at most ``max_retries`` times; each re-admission
+   waits ``retry_backoff_s * 2**(retries-1)`` before becoming eligible
+   (``Request.not_before_s``), so a thrashing tenant cannot hot-loop the
+   admission path. Defaults (0 backoff, unbounded) preserve the PR-12
+   preemption-resume behavior.
+
+The brownout controller (:class:`BrownoutController`) rides on the same
+slack computation: under page-pool or queue pressure it sheds the waiting
+requests that are already past their deadline-slack floor — work that is
+doomed anyway — instead of letting it collapse p99 for every tenant.
 """
 
 from __future__ import annotations
@@ -38,37 +55,61 @@ from distributeddeeplearning_tpu.serve.kv_cache import pages_needed
 @dataclasses.dataclass(frozen=True)
 class TenantPolicy:
     """What the engine owes a tenant (TTFT SLO) and what the tenant may
-    hold (page cap across its live slots; None = uncapped)."""
+    hold (page cap across its live slots; None = uncapped). The deadlines
+    are hard budgets, distinct from the soft SLO: past ``ttft_deadline_s``
+    a still-waiting request is expired; past ``total_deadline_s`` a request
+    is expired/cancelled wherever it is. None (default) = unenforced."""
 
     name: str
     ttft_slo_s: float = 1.0
     max_pages: Optional[int] = None
+    ttft_deadline_s: Optional[float] = None
+    total_deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class Plan:
     """One step's scheduling decision: requests to admit, in priority
-    order, and at most one live slot id to preempt first."""
+    order, at most one live slot id to preempt first, waiting requests to
+    expire (deadline missed before first token), and live slot ids to
+    cancel (total-latency budget blown mid-decode)."""
 
     admit: tuple
     preempt: tuple
+    expire: tuple = ()
+    cancel: tuple = ()
 
     @property
     def empty(self) -> bool:
-        return not self.admit and not self.preempt
+        return (not self.admit and not self.preempt and not self.expire
+                and not self.cancel)
 
 
 class SloScheduler:
     """Deadline-slack scheduler over the engine's wait queue.
 
     ``policies`` maps tenant name -> :class:`TenantPolicy`; unknown
-    tenants get ``default_policy``.
+    tenants get ``default_policy``. ``max_retries``/``retry_backoff_s``
+    bound re-admission of preempted/re-queued victims: the engine consults
+    them when it re-queues a request.
     """
 
     def __init__(self, policies: Optional[Sequence[TenantPolicy]] = None,
-                 default_policy: Optional[TenantPolicy] = None):
+                 default_policy: Optional[TenantPolicy] = None,
+                 *, max_retries: Optional[int] = None,
+                 retry_backoff_s: float = 0.0):
         self.default_policy = default_policy or TenantPolicy("default")
         self.policies = {p.name: p for p in (policies or ())}
+        self.max_retries = max_retries
+        self.retry_backoff_s = float(retry_backoff_s)
+
+    def retry_delay_s(self, retries: int) -> float:
+        """Exponential backoff before re-admission eligibility: the Nth
+        retry waits ``retry_backoff_s * 2**(N-1)`` seconds. 0 when backoff
+        is unconfigured — the PR-12 immediate-requeue behavior."""
+        if self.retry_backoff_s <= 0 or retries <= 0:
+            return 0.0
+        return self.retry_backoff_s * (2.0 ** (retries - 1))
 
     def policy(self, tenant: str) -> TenantPolicy:
         return self.policies.get(tenant, self.default_policy)
@@ -88,13 +129,47 @@ class SloScheduler:
             tenant_pages[s.tenant] = (tenant_pages.get(s.tenant, 0)
                                       + s.num_pages)
 
-        order = sorted(waiting,
+        # Deadline enforcement first: expired work must not consume a slot.
+        expire: list = []
+        cancel: list = []
+        pending: list = []
+        for req in waiting:
+            pol = self.policy(req.tenant)
+            age = now - req.arrival_s
+            if (pol.total_deadline_s is not None
+                    and age > pol.total_deadline_s):
+                expire.append(req)
+            elif (pol.ttft_deadline_s is not None
+                    and age > pol.ttft_deadline_s
+                    and getattr(req, "ttft_s", None) is None):
+                # Past the first-token budget with no token out (a resumed
+                # victim that already streamed keeps its original TTFT).
+                expire.append(req)
+            else:
+                pending.append(req)
+        survivors: list = []
+        for s in live:
+            pol = self.policy(s.tenant)
+            arrival = getattr(s, "arrival_s", None)
+            if (pol.total_deadline_s is not None and arrival is not None
+                    and now - arrival > pol.total_deadline_s):
+                cancel.append(s.slot)
+                tenant_pages[s.tenant] -= s.num_pages
+                free_slots += 1
+                free_pages += s.num_pages
+            else:
+                survivors.append(s)
+        live = survivors
+
+        order = sorted(pending,
                        key=lambda r: (self.slack_s(r, now), r.arrival_s,
                                       r.uid))
         admit: list = []
         preempt: list = []
         preempted_tenants: set[str] = set()
         for req in order:
+            if getattr(req, "not_before_s", 0.0) > now:
+                continue  # backing off after a retry: holds its place
             pol = self.policy(req.tenant)
             need = pages_needed(req.total_tokens, page_size)
             if (pol.max_pages is not None
@@ -121,7 +196,8 @@ class SloScheduler:
             free_slots -= 1
             free_pages -= need
             tenant_pages[req.tenant] = tenant_pages.get(req.tenant, 0) + need
-        return Plan(admit=tuple(admit), preempt=tuple(preempt))
+        return Plan(admit=tuple(admit), preempt=tuple(preempt),
+                    expire=tuple(expire), cancel=tuple(cancel))
 
     def _victim(self, live: Sequence, tenant_pages: dict,
                 exclude: set):
@@ -138,3 +214,47 @@ class SloScheduler:
         if not candidates:
             return None
         return max(candidates, key=lambda s: s.admitted_seq)
+
+
+class BrownoutController:
+    """Graceful degradation under overload: shed doomed work, save p99.
+
+    When the page pool or the wait queue is pressured, requests whose
+    deadline slack has fallen below ``shed_slack_s`` (i.e. already overdue
+    by more than that margin) are shed — they were going to blow their SLO
+    anyway, and serving them late steals decode steps and pages from every
+    request that can still make its deadline. With no pressure, nothing is
+    ever shed: a healthy engine behaves exactly as before.
+
+    Pure host-side policy like the scheduler — deterministic and
+    unit-testable without a model.
+    """
+
+    def __init__(self, *, page_pressure: float = 0.95,
+                 queue_pressure: int = 8, shed_slack_s: float = 0.0,
+                 max_shed_per_step: int = 2):
+        if not 0.0 < page_pressure <= 1.0:
+            raise ValueError(f"page_pressure={page_pressure}: need (0, 1]")
+        self.page_pressure = float(page_pressure)
+        self.queue_pressure = int(queue_pressure)
+        self.shed_slack_s = float(shed_slack_s)
+        self.max_shed_per_step = int(max_shed_per_step)
+
+    def pressured(self, *, waiting_depth: int, free_pages: int,
+                  num_pages: int) -> bool:
+        occupancy = 1.0 - free_pages / max(1, num_pages)
+        return (occupancy >= self.page_pressure
+                or waiting_depth >= self.queue_pressure)
+
+    def plan_shed(self, *, now: float, waiting: Sequence,
+                  scheduler: SloScheduler, free_pages: int,
+                  num_pages: int) -> list:
+        """Waiting requests to shed this step, lowest slack (most overdue)
+        first, at most ``max_shed_per_step`` — empty without pressure."""
+        if not self.pressured(waiting_depth=len(waiting),
+                              free_pages=free_pages, num_pages=num_pages):
+            return []
+        overdue = [r for r in waiting
+                   if scheduler.slack_s(r, now) < -self.shed_slack_s]
+        overdue.sort(key=lambda r: (scheduler.slack_s(r, now), r.uid))
+        return overdue[:self.max_shed_per_step]

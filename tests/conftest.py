@@ -62,6 +62,9 @@ def pytest_runtest_logreport(report):
         # serve likewise: tools/marker_audit.py --expect-serve verifies the
         # engine token-identity pin survived in tier-1.
         "serve": "serve" in report.keywords,
+        # chaos likewise: --expect-serve-chaos verifies a serve+chaos soak
+        # (replica killed mid-stream, token-identical recovery) survived.
+        "chaos": "chaos" in report.keywords,
     })
 
 

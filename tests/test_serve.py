@@ -349,3 +349,311 @@ def test_bench_serve_emits_valid_provenance_record(tmp_path, monkeypatch,
     assert rec["sequential_baseline"]["tokens_per_sec_per_chip"] > 0
     assert "speedup_vs_sequential" in rec
     assert "last_serve" in written
+
+
+# --- serve chaos: grammar, integrity sweeps, injected stalls ----------------
+
+@pytest.fixture(scope="module")
+def chaos_aot(tmp_path_factory):
+    """One AOT executable cache shared by every chaos-arm engine in this
+    module: identical ServeConfig -> identical fingerprint -> the first
+    test pays the compile, the rest warm-boot (tier-1 stays cheap)."""
+    return str(tmp_path_factory.mktemp("serve-chaos-aot"))
+
+
+def _chaos_engine(cache_dir, **engine_kw):
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001
+        return t[0]
+
+    cfg = ServeConfig(model="gpt_tiny", vocab_size=VOCAB, max_slots=2,
+                      page_size=4, num_pages=32, max_pages_per_slot=8,
+                      prefill_buckets=(8, 16), compile_cache_dir=cache_dir)
+    return Engine(cfg, clock=clock, **engine_kw)
+
+
+def test_resolve_serve_filters_kinds_and_attempt_scope(monkeypatch):
+    from distributeddeeplearning_tpu.robustness import faults
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_ATTEMPT, raising=False)
+    plan = faults.resolve_serve(
+        "page_leak@2,decode_stall@4:0.25s,nan_grads@3,sigkill@5:a1")
+    # Training-only kinds never reach the serve injector...
+    assert all(f.kind in faults.SERVE_KINDS for f in plan.faults)
+    assert plan.serve_stalls() == {4: 0.25}
+    assert [f.kind for f in plan.serve_faults_at(2)] == ["page_leak"]
+    # ...and attempt-scoped faults resolve per incarnation: sigkill@5:a1
+    # is invisible on attempt 0, live on attempt 1 (a restarted replica
+    # must not be re-killed by the fault that killed its predecessor).
+    assert not plan.serve_faults_at(5)
+    monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+    replan = faults.resolve_serve("sigkill@5:a1")
+    assert [f.kind for f in replan.serve_faults_at(5)] == ["sigkill"]
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_plan("page_fault@2")
+
+
+def test_allocator_release_is_idempotent_and_leak_check_is_loud():
+    alloc = kv_cache.PageAllocator(8)
+    held = alloc.alloc(3)
+    assert alloc.release(held) == 3
+    # Victim retirement may race engine cleanup: the second release of the
+    # same pages frees nothing and never raises.
+    assert alloc.release(held) == 0
+    assert alloc.free_pages == 8
+
+    owned = alloc.alloc(2)
+    alloc.check_leaks(owned)  # balanced: every held page owned exactly once
+    leaked = alloc.alloc(1)   # dropped on the floor, no table owns it
+    with pytest.raises(RuntimeError, match="KV page leak"):
+        alloc.check_leaks(owned)
+    alloc.release(leaked)
+    alloc.check_leaks(owned)
+    with pytest.raises(RuntimeError, match="page-table corruption"):
+        alloc.check_leaks(owned + owned)  # one page on two slots' tables
+
+
+@pytest.mark.chaos
+def test_page_leak_fault_trips_next_step_integrity_sweep(chaos_aot):
+    eng = _chaos_engine(chaos_aot, fault_plan="page_leak@1")
+    eng.submit([1, 2, 3, 4], max_new_tokens=3)
+    eng.step()  # boundary injector leaks one page AFTER this step
+    with pytest.raises(RuntimeError, match="KV page leak"):
+        eng.step()  # the sweep fires before anything dispatches
+
+
+@pytest.mark.chaos
+def test_corrupt_page_table_fault_trips_next_step_integrity_sweep(chaos_aot):
+    eng = _chaos_engine(chaos_aot, fault_plan="corrupt_page_table@1")
+    eng.submit([1, 2, 3, 4], max_new_tokens=3)
+    eng.step()
+    with pytest.raises(RuntimeError, match="page-table corruption"):
+        eng.step()
+
+
+@pytest.mark.chaos
+def test_decode_stall_fault_injects_sleep_once(chaos_aot):
+    stalls = []
+    eng = _chaos_engine(chaos_aot, fault_plan="decode_stall@1:0.25s",
+                        stall=stalls.append)
+    eng.step()
+    assert stalls == [0.25]
+    eng.step()
+    assert stalls == [0.25]  # step-scoped: fires exactly once
+
+
+# --- deadlines, bounded retry, brownout -------------------------------------
+
+def test_ttft_deadline_expires_waiting_request(chaos_aot):
+    sched = SloScheduler([TenantPolicy("rt", ttft_deadline_s=0.0)])
+    eng = _chaos_engine(chaos_aot, scheduler=sched)
+    req = eng.submit([1, 2, 3, 4], max_new_tokens=3, tenant="rt")
+    eng.step()  # already past the (zero) first-token budget: never admits
+    assert req.failed == "deadline"
+    assert eng.deadline_misses == 1 and eng.failed == [req]
+    assert eng.num_live == 0 and not eng.waiting
+
+
+def test_total_deadline_cancels_live_slot_and_returns_pages(chaos_aot):
+    sched = SloScheduler([TenantPolicy("rt", total_deadline_s=0.004)])
+    eng = _chaos_engine(chaos_aot, scheduler=sched)
+    req = eng.submit([1, 2, 3, 4], max_new_tokens=16, tenant="rt")
+    for _ in range(16):
+        if req.failed is not None:
+            break
+        eng.step()
+    assert req.failed == "deadline"
+    assert len(req.tokens) >= 1  # it WAS streaming when the budget blew
+    assert eng.deadline_misses == 1
+    assert eng.num_live == 0 and eng.allocator.pages_in_use == 0
+
+
+def test_retry_backoff_schedule_and_admission_hold():
+    sched = SloScheduler(max_retries=2, retry_backoff_s=0.5)
+    assert sched.retry_delay_s(0) == 0.0
+    assert sched.retry_delay_s(1) == 0.5
+    assert sched.retry_delay_s(2) == 1.0
+    assert sched.retry_delay_s(3) == 2.0
+    # A backing-off victim holds its queue place but is not admitted.
+    r = _req(0)
+    r.not_before_s = 5.0
+    plan = sched.plan(now=1.0, waiting=[r], live=[], free_slots=2,
+                      free_pages=100, page_size=4)
+    assert plan.empty
+    plan = sched.plan(now=6.0, waiting=[r], live=[], free_slots=2,
+                      free_pages=100, page_size=4)
+    assert [q.uid for q in plan.admit] == [0]
+
+
+def test_preemption_retry_budget_exhaustion_fails_request(chaos_aot):
+    sched = SloScheduler([TenantPolicy("bg", max_pages=8)], max_retries=0)
+    eng = _chaos_engine(chaos_aot, scheduler=sched)
+    bg0 = eng.submit([1, 2, 3, 4], max_new_tokens=12, tenant="bg")
+    bg1 = eng.submit([5, 6, 7, 8], max_new_tokens=12, tenant="bg")
+    eng.step()  # both bg requests admitted: engine full
+    assert eng.num_live == 2
+    # The bg tenant's budget collapses; a starved rt arrival evicts the
+    # newest bg slot, and with max_retries=0 the victim is not re-queued —
+    # it fails loudly instead of thrashing admission forever.
+    sched.policies["bg"] = TenantPolicy("bg", max_pages=0)
+    eng.submit([9, 10, 11, 12], max_new_tokens=3, tenant="rt")
+    for _ in range(6):
+        if bg0.failed or bg1.failed:
+            break
+        eng.step()
+    assert [bg0.failed, bg1.failed].count("retries_exhausted") == 1
+    assert eng.retries == 1
+
+
+def test_brownout_plan_shed_orders_most_overdue_first_and_caps():
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        BrownoutController)
+    sched = SloScheduler([TenantPolicy("rt", ttft_slo_s=0.1)])
+    ctrl = BrownoutController(queue_pressure=3, max_shed_per_step=2)
+    waiting = [_req(0, "rt", arrival=0.9), _req(1, "rt", arrival=0.2),
+               _req(2, "rt", arrival=0.5)]
+    # Everything is overdue, but with no pressure NOTHING is shed.
+    assert ctrl.plan_shed(now=2.0, waiting=waiting[:2], scheduler=sched,
+                          free_pages=10, num_pages=10) == []
+    # Pressured: most-overdue first, capped at max_shed_per_step.
+    shed = ctrl.plan_shed(now=2.0, waiting=waiting, scheduler=sched,
+                          free_pages=10, num_pages=10)
+    assert [r.uid for r in shed] == [1, 2]
+    # Page pressure alone also arms it; positive slack is never shed.
+    ctrl2 = BrownoutController(page_pressure=0.5, queue_pressure=99,
+                               shed_slack_s=0.0)
+    fresh = _req(3, "rt", arrival=1.99)
+    shed = ctrl2.plan_shed(now=2.0, waiting=[waiting[1], fresh],
+                           scheduler=sched, free_pages=4, num_pages=10)
+    assert [r.uid for r in shed] == [1]
+
+
+def test_engine_brownout_sheds_on_queue_pressure(chaos_aot):
+    from distributeddeeplearning_tpu.serve.scheduler import (
+        BrownoutController)
+    sched = SloScheduler([TenantPolicy("rt", ttft_slo_s=0.0)])
+    eng = _chaos_engine(chaos_aot, scheduler=sched,
+                        brownout=BrownoutController(queue_pressure=2,
+                                                    max_shed_per_step=2))
+    a = eng.submit([1, 2, 3, 4], max_new_tokens=3, tenant="rt")
+    b = eng.submit([5, 6, 7, 8], max_new_tokens=3, tenant="rt")
+    eng.step()  # depth 2 >= queue_pressure, both already past their SLO
+    assert a.failed == "shed" and b.failed == "shed"
+    assert eng.sheds == 2 and eng.num_live == 0
+
+
+def test_anomaly_update_serve_kinds():
+    from distributeddeeplearning_tpu.observability import anomaly
+    det = anomaly.AnomalyDetector()
+    # A healthy engine never trips: steady queue, zero sheds, on-time work.
+    for s in range(1, 7):
+        assert det.update_serve(s, queue_depth=2, sheds=0,
+                                deadline_misses=0, finished=3) == []
+    kinds = [a["kind"] for a in det.update_serve(
+        7, queue_depth=40, sheds=3, deadline_misses=2, finished=2)]
+    assert kinds == ["queue_blowup", "shed_storm", "deadline_miss_rate"]
+    # Below-volume misses stay quiet (1 of 100 is not a miss-rate storm).
+    assert det.update_serve(8, deadline_misses=1, finished=99) == []
+
+
+# --- the serve chaos soak: SIGKILL a replica mid-stream ---------------------
+
+@pytest.mark.chaos
+def test_serve_chaos_soak_sigkill_replica_token_identical(tmp_path):
+    """SIGKILL replica 0 at engine step 3 through the supervised launch
+    path: its in-flight requests are re-dispatched with their received
+    prefix folded, every completion is token-identical to an uninterrupted
+    run, the replacement replica warm-boots from the shared AOT cache, no
+    page leaks survive the drain, and the flight recorder tells the whole
+    story end to end."""
+    import dataclasses
+    import os
+
+    from distributeddeeplearning_tpu import launch as launchlib
+    from distributeddeeplearning_tpu.observability import flight as flightlib
+    from tools import postmortem
+
+    cfg = ServeConfig(model="gpt_tiny", vocab_size=VOCAB, max_slots=2,
+                      page_size=4, num_pages=32, max_pages_per_slot=8,
+                      prefill_buckets=(16,),
+                      compile_cache_dir=str(tmp_path / "aot"))
+    prompts = [[(7 * i + j) % (VOCAB - 1) + 1 for j in range(4 + i % 3)]
+               for i in range(4)]
+
+    # Fault-free reference through one in-process engine. This also
+    # compiles into the shared AOT cache, so both replicas (and the warm
+    # restart) boot with zero retraces — the soak stays tier-1 cheap.
+    ref = Engine(cfg)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=6)
+    ref.run_until_idle()
+    expected = {r.uid: list(r.tokens) for r in ref.finished}
+    ref.shutdown()
+    assert len(expected) == 4
+
+    requests = [{"uid": i, "prompt": prompts[i], "max_new_tokens": 6}
+                for i in range(4)]
+    flight_dir = str(tmp_path / "flight")
+    try:
+        out = launchlib.run_serve(
+            2, requests, dataclasses.asdict(cfg),
+            workdir=str(tmp_path / "serve"),
+            heartbeat_dir=str(tmp_path / "hb"),
+            max_restarts=1, child_fault_plans={0: "sigkill@3"},
+            flight_dir=flight_dir, timeout_s=150.0)
+    finally:
+        # run_serve exports the flight env for its children; scrub it so
+        # later tests see a pristine recorder.
+        flightlib.reset()
+        os.environ.pop(flightlib.ENV_FLIGHT_DIR, None)
+        os.environ.pop(flightlib.ENV_RUN_ID, None)
+
+    # Token identity across the kill: every stream equals the fault-free
+    # reference, including the re-dispatched victims.
+    for uid, exp in expected.items():
+        res = out["results"][uid]
+        assert res["finished"] and res["failed"] is None
+        assert res["tokens"] == exp, f"request {uid} diverged after replay"
+    assert out["restarts"] == 1
+    assert out["redispatched"] >= 1
+    assert any(out["results"][u]["retries"] for u in expected)
+    assert out["leak_check_ok"] is True
+    assert out["replica_rcs"] == {0: 0, 1: 0}
+
+    # The incident chain reads end-to-end: lost -> re-dispatched ->
+    # token-identical replay -> warm restart -> clean drain.
+    chain = " | ".join(postmortem.build_report(flight_dir)["incident"])
+    assert "serve replica 0 lost" in chain
+    assert "re-dispatched to survivors" in chain
+    assert "replayed token-identically" in chain
+    assert "restarted warm" in chain
+    assert "drained with leak check ok" in chain
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_bench_serve_chaos_arm_record(tmp_path, monkeypatch, capsys):
+    from distributeddeeplearning_tpu.observability import perf_report
+    from distributeddeeplearning_tpu.observability import sidecars
+    from tools import bench_serve
+
+    monkeypatch.setattr(sidecars, "write",
+                        lambda name, payload: str(tmp_path / "s.json"))
+    rc = bench_serve.main([
+        "--chaos", "--model", "gpt_tiny", "--vocab-size", str(VOCAB),
+        "--requests", "4", "--rate", "1000", "--max-new", "6",
+        "--prompt-lens", "4,6", "--max-slots", "2", "--page-size", "4",
+        "--num-pages", "32", "--max-pages-per-slot", "8",
+        "--prefill-buckets", "16",
+        "--compile-cache-dir", str(tmp_path / "aot")])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert perf_report.validate(rec) == []
+    ch = rec["chaos"]
+    assert ch["token_identity_checked"] is True
+    assert ch["leak_check_ok"] is True
+    assert ch["restarts"] >= 1 and ch["redispatched"] >= 1
+    assert ch["tokens_per_sec_per_chip"] > 0
+    assert isinstance(ch["recovery_overhead_frac"], float)

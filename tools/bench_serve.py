@@ -24,7 +24,12 @@ load model) against two arms over the SAME request trace:
 
 Both arms run greedy, so outputs are token-identical — the bench asserts
 it request-by-request (``token_identity_checked``) before reporting any
-number. Records are provenance-stamped via observability/perf_report.py;
+number. ``--chaos`` adds a third, supervised arm (launch.run_serve, two
+replicas): the same trace fault-free and then under ``sigkill`` +
+``decode_stall`` injection, reporting p50/p99 TTFT, tokens/sec/chip and
+``recovery_overhead_frac`` — after asserting the recovered streams are
+token-identical to the fault-free run and the page-leak check held.
+Records are provenance-stamped via observability/perf_report.py;
 the summary lands in the ``last_serve`` sidecar
 (observability/sidecars.py) for tools/doctor.py.
 """
@@ -32,6 +37,7 @@ the summary lands in the ``last_serve`` sidecar
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -155,6 +161,15 @@ def main(argv=None) -> int:
     p.add_argument("--compile-cache-dir", default=None)
     p.add_argument("--skip-baseline", action="store_true",
                    help="continuous arm only (no speedup field)")
+    p.add_argument("--chaos", action="store_true",
+                   help="add a supervised chaos arm: the same trace "
+                        "through launch.run_serve twice (2 replicas) — "
+                        "fault-free, then with replica 0 SIGKILLed "
+                        "mid-decode and replica 1 decode-stalled — and "
+                        "report p50/p99 TTFT, tokens/sec/chip and the "
+                        "recovery overhead vs the supervised fault-free "
+                        "window, asserting recovery is token-identical "
+                        "and the page-leak check holds")
     args = p.parse_args(argv)
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
@@ -224,6 +239,11 @@ def main(argv=None) -> int:
                 [s for r in cont["requests"] for s in r.itl_s]),
             "steps": cont["steps"], "preemptions": cont["preemptions"],
             "finished": len(cont["requests"]),
+            # Degradation counters for tools/doctor.py serve health: a
+            # fault-free bench run must show zeros here.
+            "sheds": engine.sheds,
+            "deadline_misses": engine.deadline_misses,
+            "retries": engine.retries,
         }
         rec["aot"] = engine.aot_stats()
 
@@ -248,6 +268,65 @@ def main(argv=None) -> int:
                      if r["itl_s"] is not None]),
             }
             rec["speedup_vs_sequential"] = round(cont_tps / seq_tps, 2)
+
+        if args.chaos:
+            import tempfile
+
+            from distributeddeeplearning_tpu import launch as launchlib
+
+            kill_step = max(2, args.max_new // 2)
+            stall_step = max(1, kill_step - 1)
+            plans = {0: f"sigkill@{kill_step}",
+                     1: f"decode_stall@{stall_step}:0.05s"}
+            cfg_dict = dataclasses.asdict(cfg)
+            reqs = [{"prompt": t["prompt"],
+                     "max_new_tokens": t["max_new_tokens"],
+                     "tenant": t["tenant"], "arrival_s": t["arrival_s"]}
+                    for t in trace]
+            # Two supervised runs over the same trace: the fault-free one
+            # is the honest reference (same spawn + warm-boot cost), so
+            # recovery_overhead_frac isolates what the faults cost, not
+            # what process supervision costs. Both warm-boot from the AOT
+            # cache the in-process arm above already populated.
+            ok_run = launchlib.run_serve(
+                2, reqs, cfg_dict,
+                workdir=tempfile.mkdtemp(prefix="ddl-bserve-ok-"),
+                heartbeat_dir=tempfile.mkdtemp(prefix="ddl-bserve-okhb-"),
+                timeout_s=300.0)
+            chaos_run = launchlib.run_serve(
+                2, reqs, cfg_dict,
+                workdir=tempfile.mkdtemp(prefix="ddl-bserve-chaos-"),
+                heartbeat_dir=tempfile.mkdtemp(prefix="ddl-bserve-chb-"),
+                child_fault_plans=plans, max_restarts=1, timeout_s=300.0)
+            mism = [uid for uid, r in chaos_run["results"].items()
+                    if r["tokens"] != cont["requests"][int(uid)].tokens]
+            if mism:
+                raise AssertionError(
+                    f"chaos-arm tokens diverge from the fault-free run "
+                    f"for requests {sorted(mism)[:5]} — recovery must be "
+                    f"token-identical; do not trust these numbers")
+            if not chaos_run["leak_check_ok"]:
+                raise AssertionError(
+                    "page-leak check failed at replica drain after the "
+                    "chaos soak — the allocator lost accounting")
+            ttfts = [r["ttft_s"] for r in chaos_run["results"].values()
+                     if r["ttft_s"] is not None]
+            chaos_tokens = sum(len(r["tokens"]) for r in
+                               chaos_run["results"].values())
+            rec["chaos"] = {
+                "replicas": 2, "fault_plans": plans,
+                "token_identity_checked": True,
+                "leak_check_ok": True,
+                "redispatched": chaos_run["redispatched"],
+                "restarts": chaos_run["restarts"],
+                "tokens_per_sec_per_chip": round(
+                    chaos_tokens / chaos_run["window_s"] / n_chips, 1),
+                "ttft_s": {"p50": _pct(ttfts, 50), "p99": _pct(ttfts, 99)},
+                "fault_free_window_s": round(ok_run["window_s"], 3),
+                "chaos_window_s": round(chaos_run["window_s"], 3),
+                "recovery_overhead_frac": round(
+                    chaos_run["window_s"] / ok_run["window_s"] - 1, 3),
+            }
 
         mid_context = int(np.mean(prompt_lens)) + args.max_new // 2
         roof = flopslib.decode_roofline(

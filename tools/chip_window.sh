@@ -278,6 +278,21 @@ if [ "${DDL_SERVE:-0}" = "1" ]; then
   note serve
 fi
 
+# 10c. Serve chaos soak (gated, OFF by default: CPU-only like the DDL_CHAOS
+# step — ask with DDL_SERVE_CHAOS=1). The same Poisson trace through the
+# supervised replica path fault-free and under sigkill + decode_stall,
+# recording p50/p99 TTFT, tokens/sec/chip and recovery_overhead_frac with
+# token-identity and the page-leak check asserted (docs/serving.md).
+if [ "${DDL_SERVE_CHAOS:-0}" = "1" ]; then
+  check_stop serve_chaos
+  timeout 900 env JAX_PLATFORMS=cpu python tools/bench_serve.py --chaos \
+    --model gpt_tiny --vocab-size 128 --requests 6 --rate 50 --max-new 8 \
+    --prompt-lens 4,6 --max-slots 2 --page-size 4 --num-pages 32 \
+    --max-pages-per-slot 8 --prefill-buckets 16 \
+    > "$RES/serve_chaos.json" 2>> "$RES/log.txt"
+  note serve_chaos
+fi
+
 check_stop flash
 # 11. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
 timeout 600 python tools/validate_flash_tpu.py \

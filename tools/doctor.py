@@ -337,15 +337,34 @@ def check_serve() -> None:
             return
         rec = side.get("record") or {}
         cont = rec.get("continuous") or {}
+        chaos = rec.get("chaos") or {}
         age = sidecars.age_s(side)
+        # Serve health proper: shed / deadline-miss / retry counts from
+        # the last bench window (nonzero on a fault-free run means the
+        # SLO config or pool sizing is wrong), plus the chaos arm's
+        # recovery story when bench_serve ran with --chaos.
+        extra = {}
+        if chaos:
+            extra = {
+                "chaos_recovery_overhead_frac":
+                    chaos.get("recovery_overhead_frac"),
+                "chaos_redispatched": chaos.get("redispatched"),
+                "chaos_restarts": chaos.get("restarts"),
+                "chaos_token_identity":
+                    chaos.get("token_identity_checked"),
+                "chaos_leak_check_ok": chaos.get("leak_check_ok"),
+            }
         emit("serve", ok=True,
              tokens_per_sec_per_chip=rec.get("value"),
              speedup_vs_sequential=rec.get("speedup_vs_sequential"),
              ttft_s=cont.get("ttft_s"),
              preemptions=cont.get("preemptions"),
+             sheds=cont.get("sheds"),
+             deadline_misses=cont.get("deadline_misses"),
+             retries=cont.get("retries"),
              model=rec.get("model"), provenance=rec.get("provenance"),
              aot_sources=(rec.get("aot") or {}).get("sources"),
-             age_s=round(age, 1) if age is not None else None)
+             age_s=round(age, 1) if age is not None else None, **extra)
     except Exception as e:
         emit("serve", ok=True, error=str(e)[:200])
 

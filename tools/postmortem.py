@@ -57,7 +57,8 @@ def incident_chain(events: list[dict]) -> list[str]:
     # preemption, an abort) over the child_exit that merely reports its
     # consequence — the exit still contributes the attribution verdict.
     roots = [e for e in events if e.get("ev") in
-             ("fault", "heartbeat_stale", "preempted", "abort")]
+             ("fault", "heartbeat_stale", "preempted", "abort",
+              "serve_replica_lost")]
     exits = [e for e in events
              if e.get("ev") == "child_exit" and e.get("rc")]
     trig = roots[-1] if roots else (exits[-1] if exits else None)
@@ -78,6 +79,11 @@ def incident_chain(events: list[dict]) -> list[str]:
     elif ev == "abort":
         chain.append(f"host {trig.get('host')} aborted: "
                      f"{trig.get('error')} ({trig.get('detail')})")
+    elif ev == "serve_replica_lost":
+        chain.append(f"serve replica {trig.get('replica')} lost at engine "
+                     f"step {trig.get('step')} "
+                     f"({trig.get('attribution')}, rc={trig.get('rc')}) "
+                     f"with {trig.get('inflight')} request(s) in flight")
     else:
         chain.append(f"child {trig.get('child')} exited rc={trig.get('rc')}")
     # The verdict usually follows the trigger within the same poll.
@@ -88,6 +94,16 @@ def incident_chain(events: list[dict]) -> list[str]:
                          f"(child {e.get('child')}, rc={e.get('rc')})")
             break
     after = [e for e in events if e.get("t", 0.0) >= t0]
+    # Serve incidents narrate recovery in requests, not checkpoints: the
+    # victims re-dispatched to survivors, then replayed token-identically.
+    redispatched = [e for e in after if e.get("ev") == "serve_redispatch"]
+    if redispatched:
+        chain.append(f"{len(redispatched)} in-flight request(s) "
+                     f"re-dispatched to survivors")
+    replayed = [e for e in after if e.get("ev") == "serve_replayed"]
+    if replayed and all(e.get("token_identical") for e in replayed):
+        chain.append(f"{len(replayed)} request(s) replayed "
+                     f"token-identically")
     for e in after:
         if e.get("ev") == "reconfiguration":
             chain.append(f"re-formed {e.get('degree_before')}→"
@@ -100,8 +116,12 @@ def incident_chain(events: list[dict]) -> list[str]:
                          f"({e.get('trigger')})")
     for e in after:
         if e.get("ev") == "restart":
-            chain.append(f"restart {e.get('restart')} "
-                         f"(backoff {e.get('backoff_s')} s)")
+            if e.get("scope") == "serve":
+                chain.append(f"replica {e.get('child')} restarted warm "
+                             f"(attempt {e.get('attempt')})")
+            else:
+                chain.append(f"restart {e.get('restart')} "
+                             f"(backoff {e.get('backoff_s')} s)")
             break
     for e in after:
         if e.get("ev") == "restore":
@@ -118,6 +138,9 @@ def incident_chain(events: list[dict]) -> list[str]:
         elif e.get("ev") == "giving_up":
             chain.append(f"gave up after {e.get('restarts')} restart(s) "
                          f"(rc={e.get('rc')})")
+        elif e.get("ev") == "serve_drained":
+            chain.append("drained with leak check "
+                         + ("ok" if e.get("leak_check_ok") else "FAILED"))
     return chain
 
 

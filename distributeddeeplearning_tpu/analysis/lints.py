@@ -24,6 +24,12 @@ cannot quietly regress it:
   prefill/decode program must put a flight ``record(...)`` between the
   mutation and the dispatch — the page table is the map to pool state
   a crashed replica cannot otherwise reconstruct.
+- ``cow-before-write``: a function that dispatches a KV page copy
+  (a call whose name mentions ``page_copy``/``copy_page`` — the
+  copy-on-write clone of a shared prefix page) must have flight-logged
+  a ``record(...)`` first. The clone changes which physical page a
+  slot's writes land in; a replica killed mid-copy with no record of
+  it leaves a page table a post-mortem cannot trust.
 - ``axis-name-consistency``: string axis names at ``psum`` /
   ``psum_scatter`` / ``all_gather`` / ``pmean`` / ... call sites must be
   declared in ``parallel/mesh.py``'s ``MESH_AXES`` — a typo'd axis name
@@ -298,6 +304,52 @@ def check_page_table_log_before_dispatch(tree: ast.Module,
 
 
 # ---------------------------------------------------------------------------
+# cow-before-write
+# ---------------------------------------------------------------------------
+
+def check_cow_before_write(tree: ast.Module, path: str) -> list[dict]:
+    """A copy-on-write page clone must be flight-logged before it
+    dispatches — same record-then-dispatch discipline as
+    ``page-table-log-before-dispatch``, applied to the COW copy.
+
+    The clone rewires a slot's page mapping (its writes start landing in
+    the private copy instead of the shared prefix page); a replica
+    SIGKILLed inside the copy with no record of it leaves a flight log
+    that still describes the OLD mapping. Any call whose terminal name
+    mentions ``page_copy``/``copy_page`` counts as the dispatch; a
+    ``record(...)`` lexically earlier in the same function satisfies the
+    rule."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        record_line = None
+        copies: list[int] = []
+        for sub in _shallow_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _terminal_name(sub.func)
+            if name == "record":
+                record_line = (sub.lineno if record_line is None
+                               else min(record_line, sub.lineno))
+            elif name is not None and ("page_copy" in name.lower()
+                                       or "copy_page" in name.lower()):
+                copies.append(sub.lineno)
+        for c in sorted(copies):
+            if record_line is None or record_line > c:
+                findings.append(finding(
+                    "lints", "cow-before-write",
+                    f"{node.name}() dispatches a KV page copy (line {c}) "
+                    f"with no flight record before it — a replica killed "
+                    f"mid-copy leaves a log that still describes the old "
+                    f"page mapping (copy-on-write must be logged before "
+                    f"it rewires the table)",
+                    file=path, line=c))
+                break  # one finding per function tells the story
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # axis-name-consistency
 # ---------------------------------------------------------------------------
 
@@ -392,7 +444,7 @@ def check_axis_names(tree: ast.Module, path: str,
 
 _CHECKS = (check_sidecar_writes, check_fsync_before_fire,
            check_unpaired_spans, check_perf_record_provenance,
-           check_page_table_log_before_dispatch)
+           check_page_table_log_before_dispatch, check_cow_before_write)
 
 
 def analyze_source(src: str, path: str = "<memory>", *,

@@ -81,6 +81,22 @@ def _registry() -> dict[str, ModelSpec]:
         "llama_tiny": ModelSpec(
             name="llama_tiny", build=llama.tiny_llama, input_kind="tokens",
             param_count=0, objective="causal"),
+        # Nano drafters for speculative decoding (serve/engine.py): a
+        # shrunk config of the same family — cheap to step, same
+        # tokenizer/vocab, verified by the full target model so output
+        # stays token-identical regardless of drafter quality.
+        "gpt_nano": ModelSpec(
+            name="gpt_nano", objective="causal",
+            build=lambda **kw: gpt.tiny_gpt(
+                **{"hidden_size": 32, "num_layers": 1, "num_heads": 2,
+                   **kw}),
+            input_kind="tokens", param_count=0),
+        "llama_nano": ModelSpec(
+            name="llama_nano", objective="causal",
+            build=lambda **kw: llama.tiny_llama(
+                **{"hidden_size": 32, "num_layers": 1, "num_heads": 2,
+                   "num_kv_heads": 1, "intermediate_size": 64, **kw}),
+            input_kind="tokens", param_count=0),
         # GPT-2 124M as a 4-stage GPipe pipeline over the `pipeline` axis.
         "gpt2_small_pp": ModelSpec(
             name="gpt2_small_pp", objective="causal",

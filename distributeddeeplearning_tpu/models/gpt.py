@@ -82,14 +82,20 @@ class CausalSelfAttention(nn.Module):
             # into pool pages instead of a per-request dense cache. The
             # pools are engine-seeded cache leaves — same softmax/mask
             # numerics as the dense branch below (token-identity pinned by
-            # tests/test_serve.py).
+            # tests/test_serve.py). A PagedBlockState advances each slot
+            # up to s tokens at once (suffix prefill / speculative
+            # verify); a plain PagedState is the one-token step.
             from distributeddeeplearning_tpu.serve import kv_cache as paged
             pk = self.variable("cache", "pages_k",
                                paged.unseeded_pool("pages_k"))
             pv = self.variable("cache", "pages_v",
                                paged.unseeded_pool("pages_v"))
-            out, pk.value, pv.value = paged.paged_attention_step(
-                q, k, v, pk.value, pv.value, paged_state)
+            if isinstance(paged_state, paged.PagedBlockState):
+                out, pk.value, pv.value = paged.paged_attention_block(
+                    q, k, v, pk.value, pv.value, paged_state)
+            else:
+                out, pk.value, pv.value = paged.paged_attention_step(
+                    q, k, v, pk.value, pv.value, paged_state)
         elif decode:
             # Incremental decoding: a block of s tokens (s = prompt length
             # on the prefill call, 1 per step after) is appended to a
@@ -179,12 +185,15 @@ class GptLM(nn.Module):
         if paged_state is not None and not decode:
             raise ValueError("paged_state is a decode-mode construct; "
                              "call with decode=True")
-        if paged_state is not None and s != 1:
+        paged_block = paged_state is not None and hasattr(paged_state,
+                                                         "n_new")
+        if paged_state is not None and not paged_block and s != 1:
             raise ValueError(
                 f"paged decode advances exactly one token per slot per "
                 f"step (got a block of {s}); prompts prefill through the "
                 f"dense decode path and are packed into pages "
-                f"(serve/kv_cache.pack_prefill_cache)")
+                f"(serve/kv_cache.pack_prefill_cache), or pass a "
+                f"PagedBlockState for the block fast path")
         if decode and cfg.pipeline_stages > 1:
             raise ValueError("decode (KV-cache) mode is not supported for "
                              "pipelined models; generate with the "
@@ -223,8 +232,12 @@ class GptLM(nn.Module):
             # Paged decode: every slot sits at its OWN position (the
             # engine's per-slot lengths), so the shared scalar counter the
             # dense branch keeps cannot exist — positions come from the
-            # state, shaped (B, 1) for a per-row wpe lookup.
-            pos_index = paged_state.lengths[:, None]
+            # state, shaped (B, s) for a per-row wpe lookup (s == 1 for
+            # the step path; block column t sits at lengths + t, columns
+            # past n_new are masked garbage whose lookup clips).
+            pos_index = paged_state.lengths[:, None] + jnp.arange(s)[None]
+            if paged_block:
+                pos_index = jnp.clip(pos_index, 0, cfg.max_position - 1)
         elif decode:
             # Positions continue from the decode counter (a top-level cache
             # variable advanced by the block length; per-attention cache
@@ -317,6 +330,7 @@ def gpt2_medium(vocab_size: int = 50257, dtype: Dtype = jnp.bfloat16,
 
 def tiny_gpt(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
              seq_len: Optional[int] = None, **overrides: Any) -> GptLM:
-    cfg = GptConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
-                    num_heads=4, **{"max_position": 128, **overrides})
+    cfg = GptConfig(vocab_size=vocab_size,
+                    **{"hidden_size": 64, "num_layers": 2, "num_heads": 4,
+                       "max_position": 128, **overrides})
     return GptLM(_fit_positions(cfg, seq_len), dtype=dtype)

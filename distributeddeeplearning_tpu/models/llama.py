@@ -138,20 +138,28 @@ class LlamaAttention(nn.Module):
     def _paged_decode_step(self, q, k, v, paged_state):
         """Paged decode (serve/kv_cache.py): rows are serve SLOTS, each at
         its own absolute position ``paged_state.lengths[i]`` — RoPE rotates
-        per row ((B, 1) positions) before the pool write, same
+        per row ((B, s) positions) before the pool write, same
         absolute-position-before-caching convention as the dense branch.
-        Pools are engine-seeded cache leaves at kv-head width."""
+        Pools are engine-seeded cache leaves at kv-head width. A
+        PagedBlockState advances each slot up to s tokens at once (block
+        column t rotates at lengths + t); a plain PagedState is the
+        one-token step."""
         from distributeddeeplearning_tpu.serve import kv_cache as paged
         cfg = self.cfg
-        pos = paged_state.lengths[:, None]                   # (B, 1)
+        s = q.shape[1]
+        pos = paged_state.lengths[:, None] + jnp.arange(s)[None]  # (B, s)
         q = apply_rope(q, theta=cfg.rope_theta, positions=pos)
         k = apply_rope(k, theta=cfg.rope_theta, positions=pos)
         pk = self.variable("cache", "pages_k",
                            paged.unseeded_pool("pages_k"))
         pv = self.variable("cache", "pages_v",
                            paged.unseeded_pool("pages_v"))
-        out, pk.value, pv.value = paged.paged_attention_step(
-            q, k, v, pk.value, pv.value, paged_state)
+        if isinstance(paged_state, paged.PagedBlockState):
+            out, pk.value, pv.value = paged.paged_attention_block(
+                q, k, v, pk.value, pv.value, paged_state)
+        else:
+            out, pk.value, pv.value = paged.paged_attention_step(
+                q, k, v, pk.value, pv.value, paged_state)
         return _dense(cfg.hidden_size, ("heads", "embed"), "o_proj",
                       self.dtype)(out)
 
@@ -240,12 +248,15 @@ class LlamaLM(nn.Module):
         if paged_state is not None and not decode:
             raise ValueError("paged_state is a decode-mode construct; "
                              "call with decode=True")
-        if paged_state is not None and s != 1:
+        paged_block = paged_state is not None and hasattr(paged_state,
+                                                          "n_new")
+        if paged_state is not None and not paged_block and s != 1:
             raise ValueError(
                 f"paged decode advances exactly one token per slot per "
                 f"step (got a block of {s}); prompts prefill through the "
                 f"dense decode path and are packed into pages "
-                f"(serve/kv_cache.pack_prefill_cache)")
+                f"(serve/kv_cache.pack_prefill_cache), or pass a "
+                f"PagedBlockState for the block fast path")
         pad_mask = (jnp.ones((b, s), jnp.bool_) if attention_mask is None
                     else attention_mask.astype(jnp.bool_))
 
@@ -331,6 +342,7 @@ def tiny_llama(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
     """Test-sized llama (GQA 4 heads / 2 KV heads)."""
     del seq_len
     return LlamaLM(
-        LlamaConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
-                    num_heads=4, num_kv_heads=2, intermediate_size=128,
-                    **overrides), dtype=dtype)
+        LlamaConfig(vocab_size=vocab_size,
+                    **{"hidden_size": 64, "num_layers": 2, "num_heads": 4,
+                       "num_kv_heads": 2, "intermediate_size": 128,
+                       **overrides}), dtype=dtype)

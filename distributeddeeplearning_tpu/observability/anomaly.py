@@ -24,6 +24,11 @@ Serve-side signals (fed by the replica / bench on the same cadence via
     shed_storm                           brownout shedding in bulk
     deadline_miss_rate                   a large fraction of completions
                                          are deadline misses
+    spec_acceptance_collapse             speculative-decode acceptance
+                                         cratered vs its rolling median
+                                         (drafter drift / distribution
+                                         shift — every rejected token is
+                                         wasted verify work)
 
 Design constraints, in order:
 
@@ -79,7 +84,10 @@ class AnomalyDetector:
                  queue_blowup_factor: float = 4.0,
                  queue_floor: int = 4,
                  shed_storm_min: int = 3,
-                 deadline_miss_threshold: float = 0.25):
+                 deadline_miss_threshold: float = 0.25,
+                 spec_collapse_frac: float = 0.25,
+                 spec_median_floor: float = 0.2,
+                 spec_min_proposed: int = 4):
         self.min_samples = int(min_samples)
         self.loss_margin = float(loss_margin)
         self.loss_mad_k = float(loss_mad_k)
@@ -92,10 +100,14 @@ class AnomalyDetector:
         self.queue_floor = int(queue_floor)
         self.shed_storm_min = int(shed_storm_min)
         self.deadline_miss_threshold = float(deadline_miss_threshold)
+        self.spec_collapse_frac = float(spec_collapse_frac)
+        self.spec_median_floor = float(spec_median_floor)
+        self.spec_min_proposed = int(spec_min_proposed)
         self._loss: deque = deque(maxlen=window)
         self._grad: deque = deque(maxlen=window)
         self._eps: deque = deque(maxlen=window)
         self._queue: deque = deque(maxlen=window)
+        self._accept: deque = deque(maxlen=window)
         self._straggler_streak = 0
 
     def update(self, step: int, *, loss: Any = None, grad_norm: Any = None,
@@ -188,16 +200,23 @@ class AnomalyDetector:
 
     def update_serve(self, step: int, *, queue_depth: Any = None,
                      sheds: Any = None, deadline_misses: Any = None,
-                     finished: Any = None) -> list[dict]:
+                     finished: Any = None, spec_proposed: Any = None,
+                     spec_accepted: Any = None) -> list[dict]:
         """Feed one serve-cadence observation; returns flagged anomalies.
 
         ``queue_depth`` is the instantaneous wait-queue length;
-        ``sheds``/``deadline_misses``/``finished`` are counts *for this
+        ``sheds``/``deadline_misses``/``finished`` and
+        ``spec_proposed``/``spec_accepted`` are counts *for this
         interval* (the caller diffs the engine's cumulative counters).
         Same zero-false-positive discipline as ``update()``: queue depth
-        judges against its own rolling median behind an absolute floor
-        and ``min_samples``; the storm/rate kinds need real volume before
-        they can fire, so a healthy engine never trips them."""
+        and spec acceptance judge against their own rolling medians
+        behind absolute floors and ``min_samples``; the storm/rate kinds
+        need real volume before they can fire, so a healthy engine never
+        trips them. Spec acceptance additionally requires the rolling
+        median itself to clear ``spec_median_floor`` — a drafter that
+        was never any good is a configuration problem, not an anomaly —
+        and ``spec_min_proposed`` proposals this interval, so a single
+        unlucky round stays quiet."""
         out: list[dict] = []
 
         def flag(kind: str, value: Any, baseline: Any, detail: str) -> None:
@@ -239,6 +258,23 @@ class AnomalyDetector:
                      f"missed their deadline "
                      f"({m / total:.0%} >= "
                      f"{self.deadline_miss_threshold:.0%})")
+
+        if spec_proposed is not None:
+            p = _finite(spec_proposed)
+            a = _finite(spec_accepted) if spec_accepted is not None else None
+            if p is not None and p >= self.spec_min_proposed:
+                rate = max(a or 0.0, 0.0) / p
+                if len(self._accept) >= self.min_samples:
+                    med = median(self._accept)
+                    if (med >= self.spec_median_floor
+                            and rate < self.spec_collapse_frac * med):
+                        flag("spec_acceptance_collapse", rate, med,
+                             f"spec acceptance {rate:.0%} this interval "
+                             f"({(a or 0):.0f}/{p:.0f}) vs rolling median "
+                             f"{med:.0%} — drafter has drifted from the "
+                             "target distribution; verify work is being "
+                             "wasted")
+                self._accept.append(rate)
 
         return out
 

@@ -90,11 +90,36 @@ SERVE_WORKLOAD = {
     "steps": 10,
     "warmup": 3,
 }
+# The prefix-hit admission proxy (kind="serve_prefix_prefill"): a tiny
+# Engine with the radix prefix cache ON, its tree primed with one shared
+# head; each timed step is one admission whose prompt hits that prefix —
+# radix walk, shared-page mapping, suffix block prefill, retire. The
+# serve fast path's headline win lives in this path, so a regression
+# here (retrace in the block-prefill program, host-side tree bloat, a
+# COW copy that stopped being in-place) fails tier-1 on CPU.
+SERVE_PREFIX_WORKLOAD = {
+    "kind": "serve_prefix_prefill",
+    "model": "gpt_tiny",
+    "vocab_size": 256,
+    "dtype": "float32",
+    "max_slots": 4,
+    "page_size": 4,
+    "num_pages": 64,
+    "max_pages_per_slot": 8,
+    "prefill_buckets": [8, 32],
+    "shared_prefix_len": 16,
+    "tail_len": 2,
+    "prefix_cache": True,
+    "seed": 0,
+    "steps": 10,
+    "warmup": 3,
+}
 WORKLOADS = {
     "default": WORKLOAD,
     "zero2_overlap": dict(WORKLOAD, steps=6, dp=2,
                           optimizer_sharding="zero2"),
     "serve_decode": SERVE_WORKLOAD,
+    "serve_prefix_prefill": SERVE_PREFIX_WORKLOAD,
 }
 # LR-schedule horizon compiled into the step program; fixed so every
 # measure() pass (and the AOT cache) shares one executable.
@@ -228,11 +253,18 @@ class ProxyRunner:
 
 
 class ServeProxyRunner:
-    """Decode-capacity proxy for serve/engine.py. Builds ONE tiny Engine
+    """Serve-engine proxies for serve/engine.py. Builds ONE tiny Engine
     (compile-cache off — the gate times the build in front of it, never a
-    deserialized one) and, per measurement pass, fills every slot with a
-    request long enough to stay live through the timed window: each timed
-    ``Engine.step()`` is then exactly one static-shape decode advance.
+    deserialized one); what a timed step is depends on the workload kind:
+
+    - ``serve_decode``: every slot held live by a long request — each
+      timed ``Engine.step()`` is one static-shape decode advance, the
+      per-token serving cost continuous batching pays.
+    - ``serve_prefix_prefill``: the radix tree primed with a shared head
+      — each timed step is one admission that HITS the prefix cache
+      (tree walk + shared-page mapping + suffix block prefill + retire),
+      the admission cost the fast path is supposed to have shrunk.
+
     Same result schema as :class:`ProxyRunner`, so :func:`compare` and the
     baseline file work unchanged."""
 
@@ -248,9 +280,25 @@ class ServeProxyRunner:
             num_pages=w["num_pages"],
             max_pages_per_slot=w["max_pages_per_slot"],
             prefill_buckets=tuple(w["prefill_buckets"]), seed=w["seed"],
+            prefix_cache=bool(w.get("prefix_cache", False)),
             compile_cache_dir="off")
         self.engine = Engine(self.config)
         self.engine.warmup()
+
+    def _timed_steps(self, steps, tele, inject_sleep_s):
+        """Time ``steps`` decode advances; the caller has filled every
+        slot so each one is a pure static-shape decode step."""
+        eng = self.engine
+        per_step: list[float] = []
+        for k in range(steps):
+            t0 = telemetry.now_s()
+            with tele.span("host_stall", step=k):
+                if inject_sleep_s > 0:
+                    time.sleep(inject_sleep_s)
+            with tele.span("decode_step", step=k):
+                eng.step()  # np.asarray on the emitted tokens is the sync
+            per_step.append(telemetry.now_s() - t0)
+        return per_step
 
     def measure(self, *, steps: Optional[int] = None,
                 warmup: Optional[int] = None,
@@ -261,31 +309,67 @@ class ServeProxyRunner:
         eng = self.engine
         if not eng.idle:  # leftovers from a previous pass
             eng.run_until_idle()
-        # One request per slot, sized to outlive warmup + timed steps
-        # (admission prefill emits token 1; each step emits one more).
-        prompt_len = min(4, max(self.config.prefill_buckets))
-        max_new = warmup + steps + 1
-        if prompt_len + max_new > self.config.slot_capacity:
-            raise ValueError(
-                f"serve_decode workload needs {prompt_len + max_new} "
-                f"tokens/slot but slot capacity is "
-                f"{self.config.slot_capacity}; shrink steps or grow pages")
-        for s in range(self.config.max_slots):
-            eng.submit([1 + s] * prompt_len, max_new_tokens=max_new)
-        for _ in range(warmup):
-            eng.step()
-        assert eng.num_live == self.config.max_slots
-        tele = telemetry.Telemetry(enabled=True)
-        per_step: list[float] = []
-        for k in range(steps):
-            t0 = telemetry.now_s()
-            with tele.span("host_stall", step=k):
-                if inject_sleep_s > 0:
-                    time.sleep(inject_sleep_s)
-            with tele.span("decode_step", step=k):
-                eng.step()  # np.asarray on the emitted tokens is the sync
-            per_step.append(telemetry.now_s() - t0)
-        eng.run_until_idle()
+        if w.get("kind") == "serve_prefix_prefill":
+            head_len = int(w["shared_prefix_len"])
+            tail_len = int(w["tail_len"])
+            head = [1 + (i % (w["vocab_size"] - 2))
+                    for i in range(head_len)]
+            # Prime the radix tree (one full prefill), then queue one
+            # max_new=1 request per step: each admits, hits the shared
+            # head, block-prefills only the tail, and retires in-step.
+            eng.submit(head + [2] * tail_len, max_new_tokens=1)
+            eng.run_until_idle()
+            hits_before = eng.prefix_hits
+
+            def one_admit(k: int) -> None:
+                # Submit-then-step so each step admits exactly ONE
+                # prefix-hit request (and retires it: max_new=1).
+                tail = [2 + ((k + j) % (w["vocab_size"] - 3))
+                        for j in range(tail_len)]
+                eng.submit(head + tail, max_new_tokens=1)
+                eng.step()
+
+            for k in range(warmup):
+                one_admit(k)
+            tele = telemetry.Telemetry(enabled=True)
+            per_step = []
+            for k in range(steps):
+                tail = [2 + ((warmup + k + j) % (w["vocab_size"] - 3))
+                        for j in range(tail_len)]
+                eng.submit(head + tail, max_new_tokens=1)
+                t0 = telemetry.now_s()
+                with tele.span("host_stall", step=k):
+                    if inject_sleep_s > 0:
+                        time.sleep(inject_sleep_s)
+                with tele.span("prefix_admit", step=k):
+                    eng.step()
+                per_step.append(telemetry.now_s() - t0)
+            eng.run_until_idle()
+            if eng.prefix_hits - hits_before < warmup + steps:
+                raise RuntimeError(
+                    f"serve_prefix_prefill proxy mis-primed: only "
+                    f"{eng.prefix_hits - hits_before} prefix hits for "
+                    f"{warmup + steps} admissions — the gate would be "
+                    f"timing cold prefills, not the fast path")
+        else:
+            # One request per slot, sized to outlive warmup + timed steps
+            # (admission prefill emits token 1; each step emits one more).
+            prompt_len = min(4, max(self.config.prefill_buckets))
+            max_new = warmup + steps + 1
+            if prompt_len + max_new > self.config.slot_capacity:
+                raise ValueError(
+                    f"serve_decode workload needs {prompt_len + max_new} "
+                    f"tokens/slot but slot capacity is "
+                    f"{self.config.slot_capacity}; shrink steps or grow "
+                    f"pages")
+            for s in range(self.config.max_slots):
+                eng.submit([1 + s] * prompt_len, max_new_tokens=max_new)
+            for _ in range(warmup):
+                eng.step()
+            assert eng.num_live == self.config.max_slots
+            tele = telemetry.Telemetry(enabled=True)
+            per_step = self._timed_steps(steps, tele, inject_sleep_s)
+            eng.run_until_idle()
         phases = telemetry.phase_totals(tele.snapshot())
         span_total = sum(p["total_ms"] for p in phases.values()) or 1.0
         calib = calibrate()
@@ -306,11 +390,12 @@ class ServeProxyRunner:
 
 def runner_for(workload: str = "default"):
     """The right proxy runner for a named gate workload: training loop by
-    default, the serve engine for kind="serve_decode" entries."""
+    default, the serve engine for kind="serve_decode" /
+    "serve_prefix_prefill" entries."""
     if workload == "default":
         return ProxyRunner()
     w = WORKLOADS[workload]
-    if w.get("kind") == "serve_decode":
+    if w.get("kind") in ("serve_decode", "serve_prefix_prefill"):
         return ServeProxyRunner(w)
     return ProxyRunner(w)
 

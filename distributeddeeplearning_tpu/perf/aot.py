@@ -22,6 +22,13 @@ the train step. This module removes that cost end to end:
 A cache hit loads byte-identical XLA output for the same program, so
 numerics are unchanged (the zero1<->replicated and chaos-soak bitwise pins
 hold with the cache hot or cold).
+
+The serve engine rides the same ``StepExecutableCache`` under its own
+``serve/engine.py serve_fingerprint`` (a full-``ServeConfig`` hash, so
+fast-path fields — ``prefix_cache``, ``spec_draft_model``, ``spec_k`` —
+extend the key automatically): prefill buckets, decode, and the fast
+path's block-prefill / page-clone / draft / verify programs all warm-boot
+from it.
 """
 
 from __future__ import annotations

@@ -79,12 +79,27 @@ class ServeConfig:
     max_pages_per_slot: int = 8             # page-table width
     prefill_buckets: tuple = (16, 32, 64)   # padded prompt lengths
     seed: int = 0
+    # Serve fast path (both default OFF — the PR-12 engine exactly).
+    # prefix_cache: radix-tree prefix reuse over the shared page pool —
+    # admission maps cached full prompt pages into the slot's table
+    # (refcount++) and prefills only the unmatched suffix.
+    prefix_cache: bool = False
+    # Speculative decoding: a shrunk same-family drafter proposes
+    # spec_k tokens per round; one batched verify program accepts the
+    # longest greedy-matching prefix (token-identical by construction).
+    # Both must be set together.
+    spec_draft_model: Optional[str] = None
+    spec_k: int = 0
     compile_cache_dir: Optional[str] = None
 
     @property
     def slot_capacity(self) -> int:
         """Max prompt+generated tokens a single slot can ever hold."""
         return self.page_size * self.max_pages_per_slot
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self.spec_k > 0 and self.spec_draft_model is not None
 
 
 def serve_fingerprint(config: ServeConfig) -> str:
@@ -222,7 +237,57 @@ class Engine:
             model, {**self._fresh}, num_pages=cfg.num_pages,
             page_size=cfg.page_size)
         self.allocator = kv_cache.PageAllocator(cfg.num_pages)
+
+        # Radix prefix cache: tree nodes hold allocator claims on cached
+        # full prompt pages, so a retired slot's prefix survives for the
+        # next request with the same prompt head.
+        self.prefix = (kv_cache.RadixPrefixCache(self.allocator,
+                                                 cfg.page_size)
+                       if cfg.prefix_cache else None)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
+        self.cow_copies = 0
+
+        # Speculative decoding: a shrunk same-family drafter over its OWN
+        # pools but the SAME page-id space (one allocator, one page
+        # table), so shared prefix pages carry drafter K/V too. A drafter
+        # named identically to the target shares its seed (bitwise-equal
+        # params — the always-accept path tests exercise).
+        if (cfg.spec_k > 0) != (cfg.spec_draft_model is not None):
+            raise ValueError(
+                f"speculative decoding needs BOTH spec_draft_model and "
+                f"spec_k > 0 (got draft={cfg.spec_draft_model!r}, "
+                f"k={cfg.spec_k})")
+        self._draft_model = None
+        if cfg.spec_enabled:
+            from distributeddeeplearning_tpu import models as modelslib
+            draft = modelslib.model_spec(cfg.spec_draft_model).build(
+                vocab_size=cfg.vocab_size, dtype=getattr(jnp, cfg.dtype))
+            dseed = (cfg.seed if cfg.spec_draft_model == cfg.model
+                     else cfg.seed + 1)
+            probe = jnp.zeros((1, min(cfg.prefill_buckets)), jnp.int32)
+            dvars = draft.init({"params": jax.random.key(dseed)}, probe,
+                               train=False)
+            dcap = genlib.decode_capacity(draft)
+            if dcap is not None and cfg.slot_capacity > dcap:
+                raise ValueError(
+                    f"slot capacity {cfg.slot_capacity} exceeds the "
+                    f"drafter's decode bound {dcap}")
+            self._draft_model = draft
+            self._draft_fresh = {k: v for k, v in dvars.items()
+                                 if k != "cache"}
+            self._draft_pools = kv_cache.init_pools(
+                draft, {**self._draft_fresh}, num_pages=cfg.num_pages,
+                page_size=cfg.page_size)
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
         s, p = cfg.max_slots, cfg.max_pages_per_slot
+        # Drafter cached length per slot: the drafter may lag the target
+        # by at most one token after a fully-accepted round.
+        self._d_len = np.zeros((s,), np.int32)
         self._page_table = np.zeros((s, p), np.int32)
         self._lengths = np.zeros((s,), np.int32)
         self._live = np.zeros((s,), bool)
@@ -253,7 +318,11 @@ class Engine:
             compile_cache.resolve_dir(cfg.compile_cache_dir),
             serve_fingerprint(cfg))
         self._prefill_exec: dict = {}
+        self._block_prefill_exec: dict = {}
         self._decode_exec = None
+        self._draft_decode_exec = None
+        self._verify_exec = None
+        self._clone_exec: dict = {}
 
     # -- public surface ---------------------------------------------------
 
@@ -322,15 +391,17 @@ class Engine:
             for req in self.brownout.plan_shed(
                     now=now, waiting=list(self.waiting),
                     scheduler=self.scheduler,
-                    free_pages=self.allocator.free_pages,
+                    free_pages=self._free_page_budget(),
                     num_pages=self.config.num_pages):
                 self.waiting.remove(req)
                 self._fail(req, "shed", now)
         plan = self.scheduler.plan(
             now=now, waiting=list(self.waiting), live=self._slot_views(),
             free_slots=self.config.max_slots - self.num_live,
-            free_pages=self.allocator.free_pages,
-            page_size=self.config.page_size)
+            free_pages=self._free_page_budget(),
+            page_size=self.config.page_size,
+            need_pages=(self._need_pages if self.prefix is not None
+                        else None))
         for slot in plan.cancel:
             self._cancel(slot, now)
         for req in plan.expire:
@@ -342,7 +413,10 @@ class Engine:
             self.waiting.remove(req)
             self._admit(req)
         if self.num_live:
-            self._decode_step()
+            if self._draft_model is not None:
+                self._spec_decode_step()
+            else:
+                self._decode_step()
         self.steps += 1
         reg = metrics.get()
         reg.observe("serve_live_slots", self.num_live, step=self.steps)
@@ -354,6 +428,15 @@ class Engine:
         reg.observe("serve_deadline_miss_total", self.deadline_misses,
                     step=self.steps)
         reg.observe("serve_retry_total", self.retries, step=self.steps)
+        if self.prefix is not None:
+            admits = self.prefix_hits + self.prefix_misses
+            reg.observe("serve_prefix_hit_rate",
+                        (self.prefix_hits / admits) if admits else 0.0,
+                        step=self.steps)
+        if self._draft_model is not None:
+            reg.observe("serve_spec_acceptance",
+                        (self.spec_accepted / self.spec_proposed)
+                        if self.spec_proposed else 0.0, step=self.steps)
         if self._fault_fire is not None:
             self._fault_fire(self.steps)
         return self.finished[finished_before:]
@@ -371,23 +454,63 @@ class Engine:
             f"scheduling livelock or a request that cannot ever fit")
 
     def warmup(self) -> dict:
-        """Compile (or AOT-load) the decode program and every prefill
-        bucket without touching pool contents: the dummy prefill packs
-        zero positions (plen=0) and the dummy decode has no live rows, so
-        every pool write is dropped. Returns ``aot_stats()``."""
+        """Compile (or AOT-load) every program this engine's feature set
+        will dispatch, without touching pool contents: dummy prefills
+        pack zero positions (plen/n_suffix = 0), dummy decode/verify
+        calls have no live rows, the dummy clone copies page 0 onto
+        itself — every pool write is dropped or a no-op. Which programs
+        exist depends on the config (prefix cache swaps the dense
+        prefill for the block suffix prefill + COW clone; speculation
+        swaps decode for drafter decode + verify), and all of them key
+        off the extended ``serve_fingerprint``, so a warm replica boots
+        with zero retraces whatever features are on. Returns
+        ``aot_stats()``."""
         import jax.numpy as jnp
 
-        for bucket in sorted(self.config.prefill_buckets):
-            self._run_prefill(
-                np.zeros((1, bucket), np.int32), plen=0,
-                page_row=np.zeros((self.config.max_pages_per_slot,),
-                                  np.int32))
-        tok, pools = self._decode_program()(
-            self._fresh, jnp.asarray(self._feed),
-            jnp.asarray(self._page_table), jnp.asarray(self._lengths),
-            jnp.asarray(self._live), self._pools)
-        tok.block_until_ready()
-        self._pools = pools
+        cfg = self.config
+        zero_row = np.zeros((cfg.max_pages_per_slot,), np.int32)
+        for bucket in sorted(cfg.prefill_buckets):
+            if self.prefix is not None:
+                self._run_block_prefill(
+                    np.zeros((1, bucket), np.int32), n_suffix=0,
+                    prefix_len=0, page_row=zero_row, draft=False)
+            else:
+                self._run_prefill(np.zeros((1, bucket), np.int32), plen=0,
+                                  page_row=zero_row)
+            if self._draft_model is not None:
+                self._run_block_prefill(
+                    np.zeros((1, bucket), np.int32), n_suffix=0,
+                    prefix_len=0, page_row=zero_row, draft=True)
+        if self.prefix is not None:
+            # Drive the COW clone program directly (page 0 onto itself):
+            # a compile, not a real copy — no counter, no flight event.
+            self._pools = self._clone_program(draft=False)(
+                self._pools, jnp.int32(0), jnp.int32(0))
+            if self._draft_model is not None:
+                self._draft_pools = self._clone_program(draft=True)(
+                    self._draft_pools, jnp.int32(0), jnp.int32(0))
+        if self._draft_model is not None:
+            toks, dpools = self._draft_decode_program()(
+                self._draft_fresh, jnp.asarray(self._feed),
+                jnp.asarray(self._page_table), jnp.asarray(self._d_len),
+                jnp.asarray(self._live), self._draft_pools)
+            toks.block_until_ready()
+            self._draft_pools = dpools
+            block = np.zeros((cfg.max_slots, cfg.spec_k + 1), np.int32)
+            greedy, pools = self._verify_program()(
+                self._fresh, jnp.asarray(block),
+                jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+                jnp.asarray(self._live),
+                jnp.zeros((cfg.max_slots,), jnp.int32), self._pools)
+            greedy.block_until_ready()
+            self._pools = pools
+        else:
+            tok, pools = self._decode_program()(
+                self._fresh, jnp.asarray(self._feed),
+                jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+                jnp.asarray(self._live), self._pools)
+            tok.block_until_ready()
+            self._pools = pools
         return self.aot_stats()
 
     def aot_stats(self) -> dict:
@@ -473,6 +596,193 @@ class Engine:
                                           donate_argnums=(5,))
         return self._decode_exec
 
+    def _block_prefill_program(self, bucket: int, *, draft: bool):
+        """Suffix prefill over the paged block path: processes up to
+        ``bucket`` suffix tokens at base position ``prefix_len`` against
+        a page row whose leading pages already hold the cached prefix
+        K/V (mapped shared from the radix tree). One compiled program
+        per bucket per model; ``prefix_len``/``n_suffix`` are traced
+        scalars, so any split within the bucket reuses it."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (bucket, draft)
+        if key in self._block_prefill_exec:
+            return self._block_prefill_exec[key]
+        model = self._draft_model if draft else self.model
+        fresh = self._draft_fresh if draft else self._fresh
+        pools = self._draft_pools if draft else self._pools
+
+        def prefill(fresh, ids, prefix_len, n_suffix, page_row, pools):
+            state = kv_cache.PagedBlockState(
+                page_table=page_row[None], lengths=prefix_len[None],
+                live=jnp.ones((1,), bool), n_new=n_suffix[None])
+            logits, mut = model.apply(
+                {**fresh, "cache": pools}, ids, train=False, decode=True,
+                paged_state=state, mutable=["cache"])
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, jnp.maximum(n_suffix - 1, 0), 1, axis=1)[:, 0]
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)[0], \
+                mut["cache"]
+
+        example = (fresh, jnp.zeros((1, bucket), jnp.int32),
+                   jnp.int32(0), jnp.int32(0),
+                   jnp.zeros((self.config.max_pages_per_slot,), jnp.int32),
+                   pools)
+        name = (f"serve_draft_prefill_{bucket}" if draft
+                else f"serve_prefix_prefill_{bucket}")
+        exec_ = self._program(name, prefill, example, donate_argnums=(5,))
+        self._block_prefill_exec[key] = exec_
+        return exec_
+
+    def _clone_program(self, *, draft: bool):
+        """The COW copy: clone one pool page row across every leaf of the
+        (target or drafter) pool tree — ``kv_cache.clone_page_rows``
+        compiled with donated pools so the clone is in-place on device."""
+        import jax.numpy as jnp
+
+        if draft in self._clone_exec:
+            return self._clone_exec[draft]
+        pools = self._draft_pools if draft else self._pools
+
+        def clone(pools, src, dst):
+            return kv_cache.clone_page_rows(pools, src, dst)
+
+        name = "serve_draft_page_clone" if draft else "serve_page_clone"
+        exec_ = self._program(name, clone,
+                              (pools, jnp.int32(0), jnp.int32(0)),
+                              donate_argnums=(0,))
+        self._clone_exec[draft] = exec_
+        return exec_
+
+    def _draft_decode_program(self):
+        """One drafter token for every slot — same shape as the target
+        decode program, over the drafter's pools and per-slot drafter
+        lengths (the drafter may trail the target by one)."""
+        import jax.numpy as jnp
+
+        if self._draft_decode_exec is not None:
+            return self._draft_decode_exec
+        draft = self._draft_model
+
+        def decode(fresh, feed, page_table, lengths, live, pools):
+            state = kv_cache.PagedState(page_table, lengths, live)
+            logits, mut = draft.apply(
+                {**fresh, "cache": pools}, feed, train=False, decode=True,
+                paged_state=state, mutable=["cache"])
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return tok, mut["cache"]
+
+        example = (self._draft_fresh, jnp.asarray(self._feed),
+                   jnp.asarray(self._page_table),
+                   jnp.asarray(self._d_len), jnp.asarray(self._live),
+                   self._draft_pools)
+        self._draft_decode_exec = self._program(
+            "serve_draft_decode", decode, example, donate_argnums=(5,))
+        return self._draft_decode_exec
+
+    def _verify_program(self):
+        """One batched target forward over each slot's [feed, proposals]
+        block: returns the target's greedy token at every block position.
+        Accepting the longest prefix where proposals match this greedy
+        output IS sequential greedy decoding — token identity by
+        construction. Rejected columns' pool writes land past the
+        accepted length and are masked garbage the next block
+        overwrites."""
+        import jax.numpy as jnp
+
+        if self._verify_exec is not None:
+            return self._verify_exec
+
+        def verify(fresh, block, page_table, lengths, live, n_new, pools):
+            state = kv_cache.PagedBlockState(page_table, lengths, live,
+                                             n_new)
+            logits, mut = self.model.apply(
+                {**fresh, "cache": pools}, block, train=False, decode=True,
+                paged_state=state, mutable=["cache"])
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return greedy, mut["cache"]
+
+        cfg = self.config
+        example = (self._fresh,
+                   jnp.zeros((cfg.max_slots, cfg.spec_k + 1), jnp.int32),
+                   jnp.asarray(self._page_table),
+                   jnp.asarray(self._lengths), jnp.asarray(self._live),
+                   jnp.zeros((cfg.max_slots,), jnp.int32), self._pools)
+        self._verify_exec = self._program("serve_verify", verify, example,
+                                          donate_argnums=(6,))
+        return self._verify_exec
+
+    def _run_block_prefill(self, padded: np.ndarray, *, n_suffix: int,
+                           prefix_len: int, page_row: np.ndarray,
+                           draft: bool) -> int:
+        import jax.numpy as jnp
+
+        bucket = padded.shape[1]
+        exec_ = self._block_prefill_program(bucket, draft=draft)
+        fresh = self._draft_fresh if draft else self._fresh
+        pools = self._draft_pools if draft else self._pools
+        tok, pools = exec_(fresh, jnp.asarray(padded),
+                           jnp.int32(prefix_len), jnp.int32(n_suffix),
+                           jnp.asarray(page_row), pools)
+        if draft:
+            self._draft_pools = pools
+        else:
+            self._pools = pools
+        return int(tok)
+
+    def _run_page_copy(self, src: int, dst: int) -> None:
+        """Copy-on-write a shared page into a slot-private one (target
+        pools and, under speculation, drafter pools). Flight-logged
+        BEFORE the copy dispatches — the ddl-lint ``cow-before-write``
+        rule pins callers to the same record-then-dispatch discipline
+        the page-table rule established."""
+        import jax.numpy as jnp
+
+        from distributeddeeplearning_tpu.observability import flight
+
+        flight.get().record("serve_cow_copy", src=int(src), dst=int(dst))
+        self.cow_copies += 1
+        self._pools = self._clone_program(draft=False)(
+            self._pools, jnp.int32(src), jnp.int32(dst))
+        if self._draft_model is not None:
+            self._draft_pools = self._clone_program(draft=True)(
+                self._draft_pools, jnp.int32(src), jnp.int32(dst))
+
+    def _free_page_budget(self) -> int:
+        """Pages admission control may count on: the allocator's free
+        list plus everything the prefix cache could evict on demand."""
+        free = self.allocator.free_pages
+        if self.prefix is not None:
+            free += self.prefix.evictable_pages()
+        return free
+
+    def _need_pages(self, req: Request) -> int:
+        """Scheduler callback under the prefix cache: charge only the
+        NEW pages an admission would allocate — full pages matched in
+        the radix tree are mapped shared, not taken from the free
+        list (the COW clone of a partial trailing page counts as
+        new)."""
+        cfg = self.config
+        matched, _ = self.prefix.match(req.prefill_ids)
+        prefix_len = min(matched, len(req.prefill_ids) - 1)
+        return (kv_cache.pages_needed(req.total_tokens, cfg.page_size)
+                - prefix_len // cfg.page_size)
+
+    def _assert_cow_writable(self, slot: int, start: int,
+                             count: int) -> None:
+        """Pages about to receive in-place writes for positions
+        ``[start, start+count)`` of ``slot`` must be exclusively held —
+        the runtime half of the COW discipline (a shared page here means
+        admission mapped a page it should have cloned)."""
+        if self.prefix is None or count <= 0:
+            return
+        ps = self.config.page_size
+        row = self._page_table[slot]
+        pages = {int(row[j]) for j in range(start // ps,
+                                            (start + count - 1) // ps + 1)}
+        self.allocator.assert_writable(pages)
+
     def _run_prefill(self, padded: np.ndarray, *, plen: int,
                      page_row: np.ndarray) -> int:
         import jax.numpy as jnp
@@ -489,27 +799,89 @@ class Engine:
 
         cfg = self.config
         slot = next(i for i, s in enumerate(self._slots) if s is None)
-        need = kv_cache.pages_needed(req.total_tokens, cfg.page_size)
-        pages = self.allocator.alloc(need)
-        if pages is None:  # scheduler raced itself — re-queue, not crash
+        ids = req.prefill_ids
+        plen = len(ids)
+
+        # Radix walk: full matched pages map in shared; the partially
+        # reused trailing page of a fully-cached prompt is cloned
+        # copy-on-write (at least one suffix token always re-runs so the
+        # prefill can emit). Matched pages are pinned (incref) up front
+        # so the eviction below can never free them out from under us.
+        prefix_len = 0
+        shared: list = []
+        cow_src: Optional[int] = None
+        if self.prefix is not None:
+            matched, mpages = self.prefix.match(ids)
+            prefix_len = min(matched, plen - 1)
+            full = prefix_len // cfg.page_size
+            shared = [int(p) for p in mpages[:full]]
+            self.allocator.incref(shared)
+            if prefix_len % cfg.page_size:
+                cow_src = int(mpages[full])
+                self.allocator.incref([cow_src])
+        need_total = kv_cache.pages_needed(req.total_tokens, cfg.page_size)
+        need_new = need_total - len(shared)
+        new_pages = self.allocator.alloc(need_new)
+        if new_pages is None and self.prefix is not None:
+            # The free list is short but the tree holds reclaimable
+            # pages: evict LRU refcount-1 nodes and retry.
+            self.prefix.evict(need_new - self.allocator.free_pages)
+            new_pages = self.allocator.alloc(need_new)
+        if new_pages is None:  # scheduler raced itself — re-queue
+            self.allocator.decref(shared)
+            if cow_src is not None:
+                self.allocator.decref([cow_src])
             self.waiting.appendleft(req)
             return
+        pages = shared + new_pages
         self._admitted_seq += 1
         self._slots[slot] = _Slot(request=req, pages=pages,
                                   admitted_seq=self._admitted_seq)
         page_row = np.zeros((cfg.max_pages_per_slot,), np.int32)
-        page_row[:need] = pages
+        page_row[:need_total] = pages
         self._page_table[slot] = page_row
 
-        ids = req.prefill_ids
-        plen = len(ids)
-        bucket = self._bucket_for(plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = ids
+        if self.prefix is not None:
+            if prefix_len > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += prefix_len
+            else:
+                self.prefix_misses += 1
         flight.get().record("serve_admit", request=req.uid,
-                            tenant=req.tenant, slot=slot, pages=need,
+                            tenant=req.tenant, slot=slot, pages=need_total,
+                            new_pages=need_new, prefix_tokens=prefix_len,
                             resumed=bool(req.tokens))
-        tok = self._run_prefill(padded, plen=plen, page_row=page_row)
+        if cow_src is not None:
+            self._run_page_copy(cow_src, pages[len(shared)])
+            self.allocator.decref([cow_src])  # unpin the clone source
+        n_suffix = plen - prefix_len
+        if self.prefix is not None:
+            self._assert_cow_writable(slot, prefix_len, n_suffix)
+            bucket = self._bucket_for(n_suffix)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n_suffix] = ids[prefix_len:]
+            tok = self._run_block_prefill(padded, n_suffix=n_suffix,
+                                          prefix_len=prefix_len,
+                                          page_row=page_row, draft=False)
+        else:
+            bucket = self._bucket_for(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = ids
+            tok = self._run_prefill(padded, plen=plen, page_row=page_row)
+        if self._draft_model is not None:
+            # Drafter prefills the same suffix over its own pools (shared
+            # prefix pages already hold drafter K/V from their original
+            # admission), so proposals start from a fully-caught-up
+            # drafter.
+            dbucket = self._bucket_for(n_suffix)
+            dpadded = np.zeros((1, dbucket), np.int32)
+            dpadded[0, :n_suffix] = ids[prefix_len:]
+            self._run_block_prefill(dpadded, n_suffix=n_suffix,
+                                    prefix_len=prefix_len,
+                                    page_row=page_row, draft=True)
+            self._d_len[slot] = plen
+        if self.prefix is not None:
+            self.prefix.insert(ids, pages)
         now = self._clock()
         flight.get().record("serve_prefill", request=req.uid, slot=slot,
                             bucket=bucket, prompt_tokens=plen)
@@ -530,6 +902,8 @@ class Engine:
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
+        for i in np.flatnonzero(self._live):
+            self._assert_cow_writable(int(i), int(self._lengths[i]), 1)
         toks, pools = self._decode_program()(
             self._fresh, jnp.asarray(self._feed),
             jnp.asarray(self._page_table), jnp.asarray(self._lengths),
@@ -544,6 +918,101 @@ class Engine:
             self._feed[i, 0] = toks[i]
             if req.remaining == 0:
                 self._retire(int(i), now)
+
+    def _spec_decode_step(self) -> None:
+        """One speculative round for every live slot: the drafter
+        proposes up to ``spec_k`` tokens (catching up its one-token lag
+        first), one batched target forward verifies the whole
+        ``[feed, proposals]`` block, and the longest prefix of proposals
+        matching the target's own greedy output is accepted — plus the
+        target's next token after the accepted prefix (the "bonus"
+        token), so even an all-rejected round advances one token exactly
+        like ``_decode_step``. Token-identical to sequential greedy by
+        construction: every emitted token is the target's argmax given
+        the same cached context.
+
+        Per-slot bounds: ``n <= remaining - 1`` (the round emits at most
+        ``n + 1`` tokens), and the drafter only steps while a slot still
+        needs catch-up or proposals (``active`` mask) so its writes can
+        never run past the slot's page budget."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        live_idx = [int(i) for i in np.flatnonzero(self._live)]
+        L = self._lengths.copy()
+        d = self._d_len.copy()
+        n_prop = np.zeros((cfg.max_slots,), np.int32)
+        steps_needed = np.zeros((cfg.max_slots,), np.int32)
+        proposals: list = [[] for _ in range(cfg.max_slots)]
+        for i in live_idx:
+            req = self._slots[i].request
+            lag = int(L[i]) - int(d[i])
+            n_prop[i] = min(cfg.spec_k, req.remaining - 1)
+            steps_needed[i] = lag + int(n_prop[i])
+            # Drafter writes [d, L+n) and verify writes [L, L+n]: all of
+            # it must be exclusively-held pages (COW discipline).
+            self._assert_cow_writable(i, int(d[i]),
+                                      int(L[i]) + int(n_prop[i]) + 1
+                                      - int(d[i]))
+        feed = np.zeros((cfg.max_slots, 1), np.int32)
+        for r in range(int(steps_needed.max()) if live_idx else 0):
+            active = np.zeros((cfg.max_slots,), bool)
+            for i in live_idx:
+                if r >= steps_needed[i]:
+                    continue
+                active[i] = True
+                pos = int(d[i])
+                if pos <= int(L[i]):
+                    # Catch-up / first proposal: the token at this
+                    # position is already known (prompt + emitted).
+                    feed[i, 0] = self._slots[i].request.output_ids[pos]
+                else:
+                    feed[i, 0] = proposals[i][pos - int(L[i]) - 1]
+            toks, dpools = self._draft_decode_program()(
+                self._draft_fresh, jnp.asarray(feed),
+                jnp.asarray(self._page_table), jnp.asarray(d),
+                jnp.asarray(active), self._draft_pools)
+            self._draft_pools = dpools
+            toks = np.asarray(toks)
+            for i in live_idx:
+                if active[i]:
+                    if int(d[i]) >= int(L[i]):
+                        proposals[i].append(int(toks[i]))
+                    d[i] += 1
+        block = np.zeros((cfg.max_slots, cfg.spec_k + 1), np.int32)
+        n_new = np.zeros((cfg.max_slots,), np.int32)
+        for i in live_idx:
+            block[i, 0] = self._feed[i, 0]
+            for j in range(int(n_prop[i])):
+                block[i, 1 + j] = proposals[i][j]
+            n_new[i] = int(n_prop[i]) + 1
+        greedy, pools = self._verify_program()(
+            self._fresh, jnp.asarray(block), jnp.asarray(self._page_table),
+            jnp.asarray(self._lengths), jnp.asarray(self._live),
+            jnp.asarray(n_new), self._pools)
+        self._pools = pools
+        greedy = np.asarray(greedy)
+        now = self._clock()
+        self.spec_rounds += 1
+        for i in live_idx:
+            req = self._slots[i].request
+            n = int(n_prop[i])
+            m = 0
+            while m < n and proposals[i][m] == int(greedy[i, m]):
+                m += 1
+            self.spec_proposed += n
+            self.spec_accepted += m
+            for j in range(m + 1):
+                req.emit(int(greedy[i, j]), now)
+            new_len = int(L[i]) + m + 1
+            self._lengths[i] = new_len
+            self._feed[i, 0] = int(greedy[i, m])
+            # Drafter cache is valid through the last position fed a
+            # true token — at most one behind the target after a fully
+            # accepted round.
+            self._d_len[i] = min(int(d[i]), new_len)
+            if req.remaining == 0:
+                self._retire(i, now)
 
     def _retire(self, slot: int, now: float) -> None:
         from distributeddeeplearning_tpu.observability import flight
@@ -621,6 +1090,7 @@ class Engine:
         self._slots[slot] = None
         self._live[slot] = False
         self._lengths[slot] = 0
+        self._d_len[slot] = 0
         self._feed[slot, 0] = 0
         self._page_table[slot] = 0
 
@@ -643,6 +1113,10 @@ class Engine:
                     f"page-table corruption: slot {i} row {row} != owned "
                     f"pages {pages}")
             owned.extend(pages)
+        if self.prefix is not None:
+            # Tree nodes hold their own claims: one per node, and a page
+            # shared with live slots must be counted once per holder.
+            owned.extend(self.prefix.owned_pages())
         self.allocator.check_leaks(owned)
 
     def corrupt_page_table(self) -> Optional[int]:
@@ -668,5 +1142,15 @@ class Engine:
                             failed=len(self.failed),
                             preemptions=self.preemptions,
                             sheds=self.sheds,
-                            deadline_misses=self.deadline_misses)
+                            deadline_misses=self.deadline_misses,
+                            prefix_hits=self.prefix_hits,
+                            prefix_misses=self.prefix_misses,
+                            prefix_tokens_reused=self.prefix_tokens_reused,
+                            prefix_evictions=(self.prefix.evictions
+                                              if self.prefix is not None
+                                              else 0),
+                            cow_copies=self.cow_copies,
+                            spec_rounds=self.spec_rounds,
+                            spec_proposed=self.spec_proposed,
+                            spec_accepted=self.spec_accepted)
         self.check_integrity()

@@ -12,9 +12,14 @@ finished sequences. Serving wants memory that scales with LIVE tokens:
 - a per-slot **page table** ``(max_slots, max_pages_per_slot)`` mapping
   each slot's token range to pool pages in position order — entry ``j``
   covers positions ``[j*page_size, (j+1)*page_size)``;
-- a host-side free list (:class:`PageAllocator`): admission takes pages,
-  retirement returns them, so a retiring slot's memory is reusable on the
-  very next step without any copying.
+- a host-side refcounted free list (:class:`PageAllocator`): admission
+  takes pages, retirement returns them, so a retiring slot's memory is
+  reusable on the very next step without any copying. Pages can be
+  SHARED — a radix prefix cache (:class:`RadixPrefixCache`) and any
+  number of slots may hold the same full page (refcount per holder);
+  a page returns to the free list only when its last claim drops, and
+  in-place writes are only legal at refcount 1 (copy-on-write above —
+  ``assert_writable`` / ``clone_page_rows`` enforce the discipline).
 
 Numerics match the dense decode branches exactly where it matters: same
 ``d**-0.5`` scale, same f32 softmax over ``finfo(f32).min``-masked dead
@@ -57,6 +62,25 @@ class PagedState(NamedTuple):
     page_table: jax.Array
     lengths: jax.Array
     live: jax.Array
+
+
+class PagedBlockState(NamedTuple):
+    """Block variant of :class:`PagedState` for the serve fast path:
+    every slot advances up to ``T`` tokens in one program call (suffix
+    prefill after a radix prefix hit; speculative verify of a drafted
+    block). Fields as in :class:`PagedState`, plus:
+
+    ``n_new`` (max_slots,) int32 — how many of the ``T`` block columns
+    are real for each slot; columns past it (and every column of a dead
+    slot) have their pool writes dropped and their logits ignored.
+    ``lengths`` is the BASE position: block column ``t`` of slot ``i``
+    sits at absolute position ``lengths[i] + t``.
+    """
+
+    page_table: jax.Array
+    lengths: jax.Array
+    live: jax.Array
+    n_new: jax.Array
 
 
 def pages_needed(total_tokens: int, page_size: int) -> int:
@@ -132,6 +156,68 @@ def paged_attention_step(q, k_new, v_new, pool_k, pool_v,
                            axis=-1).astype(q.dtype)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_ctx)
     return out.reshape(slots, 1, heads * d), pool_k, pool_v
+
+
+def paged_attention_block(q, k_new, v_new, pool_k, pool_v,
+                          state: PagedBlockState):
+    """Block of ``T`` tokens of paged attention for every slot at once.
+
+    ``q`` (S, T, heads, d); ``k_new``/``v_new`` (S, T, kv_heads, d) —
+    slot ``i``'s block column ``t`` is the token at absolute position
+    ``lengths[i] + t`` (RoPE already applied for Llama). Writes columns
+    ``t < n_new[i]`` of live slots into their pages, then attends each
+    query over its slot's gathered pages with the causal rule
+    ``visible <= lengths[i] + t``.
+
+    Numerics are bitwise-identical to ``T`` sequential
+    :func:`paged_attention_step` calls: per-(query, key) dot products
+    are independent of the block width, and masked entries go through
+    the same ``finfo(f32).min`` -> f32 softmax that underflows them to
+    exactly 0.0 — the same argument that pins paged == dense token
+    identity. Invalid columns produce finite garbage rows the engine
+    ignores; their writes are dropped via an out-of-range flat index.
+
+    Returns ``(out, pool_k, pool_v)`` with ``out`` (S, T, heads*d).
+    """
+    num_pages, page_size, kvh, d = pool_k.shape
+    slots, t_block = q.shape[0], q.shape[1]
+    heads = q.shape[2]
+    rep = heads // kvh
+    max_pages_per_slot = state.page_table.shape[1]
+
+    # --- write: column t of slot i lands at absolute position
+    #     lengths[i] + t; invalid columns (t >= n_new, dead slots) are
+    #     dropped through an out-of-range flat index -------------------
+    t_pos = state.lengths[:, None] + jnp.arange(t_block)[None, :]  # (S,T)
+    valid = ((jnp.arange(t_block)[None, :] < state.n_new[:, None])
+             & state.live[:, None])
+    page_col = jnp.clip(t_pos // page_size, 0, max_pages_per_slot - 1)
+    page_id = jnp.take_along_axis(state.page_table, page_col, axis=1)
+    flat_idx = jnp.where(valid, page_id * page_size + t_pos % page_size,
+                         num_pages * page_size)
+    flat_k = pool_k.reshape(num_pages * page_size, kvh, d)
+    flat_v = pool_v.reshape(num_pages * page_size, kvh, d)
+    flat_k = flat_k.at[flat_idx].set(k_new.astype(pool_k.dtype),
+                                     mode="drop")
+    flat_v = flat_v.at[flat_idx].set(v_new.astype(pool_v.dtype),
+                                     mode="drop")
+    pool_k = flat_k.reshape(pool_k.shape)
+    pool_v = flat_v.reshape(pool_v.shape)
+
+    # --- gather + causal attention: query (i, t) sees positions
+    #     0..lengths[i]+t inclusive, same rule as the step path --------
+    k_ctx = pool_k[state.page_table].reshape(slots, -1, kvh, d)
+    v_ctx = pool_v[state.page_table].reshape(slots, -1, kvh, d)
+    ctx = k_ctx.shape[1]
+    qg = q.reshape(slots, t_block, kvh, rep, d)
+    scores = jnp.einsum("btgrd,bkgd->bgrtk", qg, k_ctx) * (d ** -0.5)
+    visible = (jnp.arange(ctx)[None, None, :]
+               <= t_pos[:, :, None])[:, None, None, :, :]
+    scores = jnp.where(visible, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_ctx)
+    return out.reshape(slots, t_block, heads * d), pool_k, pool_v
 
 
 def init_pools(model, variables, *, num_pages: int, page_size: int):
@@ -213,18 +299,38 @@ def pack_prefill_cache(dense_cache, pools, *, page_row, plen):
     return traverse_util.unflatten_dict(flat_pools)
 
 
+def clone_page_rows(pools, src, dst):
+    """Copy one pool page row ``src`` -> ``dst`` across every pool leaf —
+    the copy-on-write primitive. A page reachable at refcount > 1 (a radix
+    prefix-cache node and/or another slot reads it) must never be written
+    in place; the engine clones it into a private page first and maps the
+    clone into the writing slot's page table. ``src``/``dst`` may be
+    traced scalars, so one compiled program serves every copy."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(pools)
+    for path, pool in flat.items():
+        flat[path] = pool.at[dst].set(pool[src])
+    return traverse_util.unflatten_dict(flat)
+
+
 class PageAllocator:
-    """Host-side free-list page allocator: admission takes, retirement
-    returns, double-free raises (a page on two slots' tables corrupts both
+    """Host-side refcounted page allocator: admission takes, retirement
+    returns, and a page may be SHARED by several holders (slots mapping a
+    cached prefix, radix-tree nodes). A page returns to the free list only
+    when its last claim drops. Double-decref raises (a claim released
+    twice means some holder's bookkeeping is wrong — left unchecked the
+    page would be handed out while still mapped, corrupting both
     sequences silently — the one failure mode this class exists to make
-    impossible)."""
+    impossible), and in-place writes to a shared page are refused by
+    :meth:`assert_writable` (copy-on-write via ``clone_page_rows``)."""
 
     def __init__(self, num_pages: int):
         if num_pages < 1:
             raise ValueError(f"num_pages={num_pages}: need >= 1")
         self.num_pages = int(num_pages)
         self._free = list(range(self.num_pages - 1, -1, -1))
-        self._held: set[int] = set()
+        self._ref: dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -235,67 +341,285 @@ class PageAllocator:
         return self.num_pages - len(self._free)
 
     def alloc(self, n: int) -> Optional[list[int]]:
-        """``n`` page ids, or None (allocate-all-or-nothing) when the pool
-        cannot cover the request — admission control's budget check."""
+        """``n`` fresh page ids at refcount 1, or None (allocate-all-or-
+        nothing) when the pool cannot cover the request — admission
+        control's budget check."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._held.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         return pages
 
     @property
     def held_pages(self) -> frozenset:
         """Pages currently allocated — the ground truth the engine's
         integrity check reconciles against the slots' page tables."""
-        return frozenset(self._held)
+        return frozenset(self._ref)
+
+    def refcount(self, page) -> int:
+        """Claims on ``page`` (0 = free)."""
+        return self._ref.get(int(page), 0)
+
+    def incref(self, pages) -> None:
+        """Add one claim per page — a new holder (slot page-table row or
+        radix-tree node) mapping an already-allocated page. Incref of a
+        free page raises: sharing can only extend a live allocation."""
+        for p in pages:
+            p = int(p)
+            if p not in self._ref:
+                raise ValueError(
+                    f"incref of page {p}: it is not currently allocated — "
+                    f"only a live page can gain a second holder")
+            self._ref[p] += 1
+
+    def decref(self, pages) -> None:
+        """Drop one claim per page; the page returns to the free list when
+        its last claim drops. Decref of a free page raises (double-decref:
+        some holder released a claim it no longer owns)."""
+        for p in pages:
+            p = int(p)
+            if p not in self._ref:
+                raise ValueError(
+                    f"double-decref of page {p}: it is not currently "
+                    f"allocated — a claim released twice would free a page "
+                    f"another holder still maps")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
 
     def free(self, pages) -> None:
+        """Strict single-claim release — kept for non-victim paths where a
+        second call IS a bug. ``decref`` semantics, double-free raises."""
         for p in pages:
-            if p not in self._held:
+            p = int(p)
+            if p not in self._ref:
                 raise ValueError(
                     f"double-free of page {p}: it is not currently "
                     f"allocated — a page on two page tables would corrupt "
                     f"both slots' K/V")
-            self._held.discard(p)
-            self._free.append(p)
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
 
     def release(self, pages) -> int:
-        """Idempotent variant of ``free`` for victim retirement: frees only
-        the pages still held, silently skipping the rest, and returns how
-        many were actually returned. A request that was preempted (pages
-        freed, re-queued) and later shed/cancelled walks this path — its
-        second cleanup must be a no-op, not a double-free crash."""
+        """Idempotent variant of ``free`` for victim retirement: drops one
+        claim per page still allocated, silently skipping free ones, and
+        returns how many claims were actually dropped. A request that was
+        preempted (pages freed, re-queued) and later shed/cancelled walks
+        this path — its second cleanup must be a no-op, not a double-free
+        crash. Holders must clear their page lists after releasing (the
+        engine's ``entry.pages = []`` pattern): idempotency is per-claim,
+        and a stale list re-released after the page found a NEW holder
+        would steal that holder's claim."""
         freed = 0
         for p in pages:
-            if p in self._held:
-                self._held.discard(p)
-                self._free.append(p)
+            p = int(p)
+            if p in self._ref:
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    del self._ref[p]
+                    self._free.append(p)
                 freed += 1
         return freed
 
+    def assert_writable(self, pages) -> None:
+        """Raise unless every page is exclusively held (refcount == 1).
+        The engine calls this on the pages a program is about to write
+        in place: a write to a shared page would corrupt the cached
+        prefix under every OTHER holder — the copy-on-write hazard this
+        check makes loud (clone first via ``clone_page_rows``)."""
+        shared = sorted(p for p in (int(p) for p in pages)
+                        if self._ref.get(p, 0) > 1)
+        if shared:
+            raise RuntimeError(
+                f"write to shared page(s) {shared} (refcount > 1): "
+                f"in-place writes are only legal at refcount 1 — "
+                f"copy-on-write the page first (kv_cache.clone_page_rows)")
+
     def check_leaks(self, owned_pages) -> None:
         """Raise unless allocator accounting balances exactly against the
-        pages owned by live slots: every held page is owned by exactly one
-        slot, every owned page is held, and free + held == num_pages. Called
-        at engine shutdown and after every chaos soak — a leak here means a
-        page was dropped on the floor (or double-owned) and the pool will
-        eventually starve admission."""
-        owned = list(owned_pages)
-        if len(owned) != len(set(owned)):
-            dupes = sorted({p for p in owned if owned.count(p) > 1})
+        claims of live holders: ``owned_pages`` is a MULTISET (each slot
+        contributes its page-table row, the radix tree one entry per
+        node), and each page's multiplicity must equal its refcount;
+        free + held == num_pages. Called at engine shutdown and after
+        every chaos soak — a leak here means a claim was dropped on the
+        floor (or a page double-owned without a matching share) and the
+        pool will eventually starve admission."""
+        counts: dict[int, int] = {}
+        for p in owned_pages:
+            p = int(p)
+            counts[p] = counts.get(p, 0) + 1
+        over = sorted(p for p, c in counts.items()
+                      if c > self._ref.get(p, 0) and p in self._ref)
+        if over:
             raise RuntimeError(
-                f"page-table corruption: page(s) {dupes} appear on more "
-                f"than one live slot's table")
-        if set(owned) != self._held:
-            leaked = sorted(self._held - set(owned))
-            phantom = sorted(set(owned) - self._held)
+                f"page-table corruption: page(s) {over} appear on more "
+                f"live tables than their refcount allows — an unshared "
+                f"page on two slots' tables corrupts both")
+        phantom = sorted(p for p in counts if p not in self._ref)
+        leaked = sorted(p for p, c in self._ref.items()
+                        if counts.get(p, 0) < c)
+        if leaked or phantom:
             raise RuntimeError(
-                f"KV page leak: allocator holds {sorted(self._held)} but "
-                f"live slots own {sorted(set(owned))} "
+                f"KV page leak: allocator refcounts {dict(self._ref)} vs "
+                f"live claims {counts} "
                 f"(leaked={leaked}, phantom={phantom})")
-        if len(self._free) + len(self._held) != self.num_pages:
+        if len(self._free) + len(self._ref) != self.num_pages:
             raise RuntimeError(
                 f"allocator accounting broken: free={len(self._free)} + "
-                f"held={len(self._held)} != num_pages={self.num_pages}")
+                f"held={len(self._ref)} != num_pages={self.num_pages}")
+
+
+class _RadixNode:
+    """One radix-tree node: owns exactly ONE pool page whose K/V covers a
+    full ``page_size``-token chunk, keyed by that chunk's token ids."""
+
+    __slots__ = ("key", "page", "children", "parent", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: dict = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Token-prefix -> KV-page radix tree over the shared page pool.
+
+    Nodes are FULL pages only (a chunk of exactly ``page_size`` token
+    ids); a node holds one allocator claim on its page, so a retired
+    slot's prefix pages survive retirement inside the tree (refcount
+    drops to the tree's 1, not to 0) and the next request with the same
+    prompt prefix maps them instead of recomputing prefill. The partial
+    trailing page of a fully-cached prompt is never shared in place —
+    the engine copy-on-writes it (``clone_page_rows``).
+
+    Eviction is LRU over leaf nodes whose page has no holder besides the
+    tree (refcount == 1): evicting frees the page back to the allocator,
+    children before parents (a leaf's parent becomes evictable next
+    round), and never touches a page some live slot still maps — so the
+    allocator's all-or-nothing budget check and ``check_leaks()`` drain
+    gate keep working unchanged.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size}: need >= 1")
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self._root = _RadixNode(None, None, None)
+        self._tick = 0
+        self.evictions = 0
+
+    def _chunks(self, tokens):
+        ps = self.page_size
+        for j in range(len(tokens) // ps):
+            yield tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+
+    def match(self, tokens) -> tuple[int, list[int]]:
+        """Longest cached full-page prefix of ``tokens``: returns
+        ``(matched_tokens, pages)`` with ``matched_tokens`` a multiple of
+        ``page_size`` and ``pages`` the node pages in position order.
+        Touches every node on the path (LRU recency)."""
+        self._tick += 1
+        node = self._root
+        pages: list[int] = []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_used = self._tick
+            pages.append(child.page)
+            node = child
+        return len(pages) * self.page_size, pages
+
+    def insert(self, tokens, pages) -> int:
+        """Register the full pages of a freshly-prefilled sequence:
+        ``pages[j]`` must hold the K/V of positions
+        ``[j*page_size, (j+1)*page_size)``, all of them written (only
+        chunks with ``page_size*(j+1) <= len(tokens)`` are considered).
+        New nodes take one allocator claim on their page; a chunk already
+        cached (under the same or a different page) is left as is.
+        Returns how many nodes were created."""
+        self._tick += 1
+        node = self._root
+        created = 0
+        for j, chunk in enumerate(self._chunks(tokens)):
+            child = node.children.get(chunk)
+            if child is None:
+                page = int(pages[j])
+                self.allocator.incref([page])
+                child = _RadixNode(chunk, page, node)
+                node.children[chunk] = child
+                created += 1
+            child.last_used = self._tick
+            node = child
+        return created
+
+    def _evictable_leaves(self) -> list:
+        out = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif self.allocator.refcount(n.page) == 1:
+                out.append(n)
+        return out
+
+    def evict(self, need: int) -> int:
+        """Free at least ``need`` pages back to the allocator by dropping
+        LRU tree-only (refcount-1) leaves, cascading into parents as they
+        become leaves. Returns how many pages were actually freed (may be
+        short when live slots pin the rest)."""
+        freed = 0
+        while freed < need:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.last_used)
+            for n in leaves:
+                if freed >= need:
+                    break
+                self.allocator.decref([n.page])
+                del n.parent.children[n.key]
+                self.evictions += 1
+                freed += 1
+        return freed
+
+    def evictable_pages(self) -> int:
+        """Pages the tree could free on demand: nodes whose whole subtree
+        is tree-only (refcount 1) — what admission control may count as
+        available on top of the allocator's free list."""
+        def count(node) -> tuple[int, bool]:
+            total, all_free = 0, True
+            for c in node.children.values():
+                sub, ok = count(c)
+                total += sub
+                all_free &= ok
+            if node is self._root:
+                return total, all_free
+            if all_free and self.allocator.refcount(node.page) == 1:
+                return total + 1, True
+            return total, False
+        return count(self._root)[0]
+
+    def owned_pages(self) -> list[int]:
+        """One entry per node — the tree's contribution to the engine's
+        ``check_leaks`` claim multiset."""
+        out: list[int] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            out.append(n.page)
+            stack.extend(n.children.values())
+        return out
+
+    def num_nodes(self) -> int:
+        return len(self.owned_pages())

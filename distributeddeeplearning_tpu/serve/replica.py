@@ -153,8 +153,20 @@ def main(argv=None) -> int:
         report_progress()
 
     eng.shutdown()  # raises on a page leak -> nonzero exit, by design
+    # Fast-path counters ride the drain event so the supervisor (and
+    # doctor's serve report) can aggregate prefix reuse and speculative
+    # acceptance across replicas without scraping flight logs.
     _emit(ev, {"ev": "drained", "replica": rid, "steps": eng.steps,
-               "finished": len(eng.finished), "failed": len(eng.failed)})
+               "finished": len(eng.finished), "failed": len(eng.failed),
+               "prefix_hits": eng.prefix_hits,
+               "prefix_misses": eng.prefix_misses,
+               "prefix_tokens_reused": eng.prefix_tokens_reused,
+               "prefix_evictions": (eng.prefix.evictions
+                                    if eng.prefix is not None else 0),
+               "cow_copies": eng.cow_copies,
+               "spec_rounds": eng.spec_rounds,
+               "spec_proposed": eng.spec_proposed,
+               "spec_accepted": eng.spec_accepted})
     return 0
 
 

@@ -120,10 +120,23 @@ class SloScheduler:
                 - now)
 
     def plan(self, *, now: float, waiting: Sequence, live: Sequence,
-             free_slots: int, free_pages: int, page_size: int) -> Plan:
+             free_slots: int, free_pages: int, page_size: int,
+             need_pages=None) -> Plan:
         """``waiting``: requests (``tenant``/``arrival_s``/``uid`` plus
         ``total_tokens`` = prompt+emitted+remaining). ``live``: slot views
-        with ``slot``/``tenant``/``num_pages``/``admitted_seq``."""
+        with ``slot``/``tenant``/``num_pages``/``admitted_seq``.
+
+        ``need_pages``: optional callable ``req -> int`` overriding the
+        page charge for a waiting request. The prefix-cache engine passes
+        one that charges only the NEW pages an admission would allocate —
+        radix-matched full pages are mapped shared (refcount++), not
+        drawn from the free list. ``free_pages`` from that engine is the
+        allocator free list plus on-demand-evictable tree pages, so the
+        all-or-nothing budget check keeps its meaning. Preemption
+        accounting is deliberately conservative: a victim's ``num_pages``
+        counts every page it maps, but releasing a shared page only
+        drops a refcount — the freed total may be smaller, and the next
+        step's re-plan corrects for it."""
         tenant_pages: dict[str, int] = {}
         for s in live:
             tenant_pages[s.tenant] = (tenant_pages.get(s.tenant, 0)
@@ -171,7 +184,8 @@ class SloScheduler:
             if getattr(req, "not_before_s", 0.0) > now:
                 continue  # backing off after a retry: holds its place
             pol = self.policy(req.tenant)
-            need = pages_needed(req.total_tokens, page_size)
+            need = (need_pages(req) if need_pages is not None
+                    else pages_needed(req.total_tokens, page_size))
             if (pol.max_pages is not None
                     and tenant_pages.get(req.tenant, 0) + need
                     > pol.max_pages):

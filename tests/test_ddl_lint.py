@@ -519,3 +519,27 @@ def test_annotate_attaches_schedule_fingerprints(monkeypatch, tmp_path):
     old = perf_report.annotate({"metric": "m", "value": 1.0},
                                provenance="fresh", with_backend=False)
     assert "collective_schedules" not in old
+
+
+def test_lint_cow_before_write():
+    """The serve fast path's COW audit invariant: a function dispatching
+    a KV page copy with no prior flight record leaves shared-page bugs
+    unattributable."""
+    src = textwrap.dedent("""
+        def admit(self, src_page, dst_page):
+            self._run_page_copy(src_page, dst_page)
+    """)
+    findings = lints.analyze_source(src, "cow.py", mesh_axes=MESH_AXES)
+    assert "cow-before-write" in _rules(findings), findings
+
+
+def test_lint_cow_recorded_clean():
+    """engine.py's actual shape: the serve_cow_copy record precedes the
+    copy dispatch."""
+    src = textwrap.dedent("""
+        def admit(self, rec, src_page, dst_page):
+            rec.record("serve_cow_copy", src=src_page, dst=dst_page)
+            self._run_page_copy(src_page, dst_page)
+    """)
+    assert lints.analyze_source(src, "cow_ok.py",
+                                mesh_axes=MESH_AXES) == []

@@ -263,3 +263,64 @@ def test_perf_gate_live_zero2_overlap(runner_zero2, monkeypatch, tmp_path):
     assert result["workload_name"] == "zero2_overlap"
     assert result["current"]["workload"]["optimizer_sharding"] == "zero2"
     assert not (tmp_path / "last.json").exists()
+
+
+# --- the serve_prefix_prefill extras workload -------------------------------
+
+@pytest.fixture(scope="module")
+def runner_serve_prefix():
+    """ONE warmed prefix-cache engine (radix tree primed with the shared
+    head) shared by the prefix-prefill gate tests."""
+    return perf_gate.ServeProxyRunner(
+        perf_gate.WORKLOADS["serve_prefix_prefill"])
+
+
+@pytest.mark.perf_gate
+@pytest.mark.serve
+def test_perf_gate_live_serve_prefix_prefill(runner_serve_prefix,
+                                             monkeypatch, tmp_path):
+    """The fast-path admission gate: one prefix-HIT admission (tree walk
+    + shared-page mapping + suffix-only block prefill + retire) must sit
+    inside its extras baseline band — a regression that silently turns
+    hits into cold full prefills, or bloats the radix walk, fails tier-1
+    here. Recalibrate with
+    `python tools/perf_gate.py --recalibrate --workload
+    serve_prefix_prefill`."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner_serve_prefix,
+                             workload="serve_prefix_prefill")
+    assert result["ok"], "\n".join(result["violations"])
+    assert result["workload_name"] == "serve_prefix_prefill"
+    assert result["current"]["workload"]["kind"] == "serve_prefix_prefill"
+    # Every timed step actually hit the tree (the runner itself raises on
+    # a mis-primed pass, so a passing check IS hit-path timing), and a
+    # serve-workload check never overwrites the headline sidecar.
+    assert result["current"]["phase_share"].get("prefix_admit", 0) > 0.5
+    assert not (tmp_path / "last.json").exists()
+
+
+@pytest.mark.perf_gate
+@pytest.mark.serve
+def test_serve_prefix_gate_flips_on_injected_stall(runner_serve_prefix):
+    """The armed-gate self-test for the prefix workload: a deliberate
+    host stall between admissions must trip step time out of band AND
+    the host_stall phase share."""
+    baseline = perf_gate.load_baseline(name="serve_prefix_prefill")
+    slow = runner_serve_prefix.measure(inject_sleep_s=0.2)
+    violations = perf_gate.compare(baseline, slow)
+    assert any("step-time regression" in v for v in violations), violations
+    assert any("phase-mix regression" in v and "host_stall" in v
+               for v in violations), violations
+
+
+def test_serve_prefix_prefill_workload_is_registered():
+    """Losing the WORKLOADS entry (or its extras baseline) silently
+    removes the fast-path gate from tools/perf_gate.py."""
+    w = perf_gate.WORKLOADS["serve_prefix_prefill"]
+    assert w["kind"] == "serve_prefix_prefill"
+    assert w["prefix_cache"] is True
+    # The shared head must span multiple full pages or the proxy times a
+    # near-empty tree walk instead of real page mapping.
+    assert w["shared_prefix_len"] >= 2 * w["page_size"]
+    assert perf_gate.load_baseline(name="serve_prefix_prefill") is not None

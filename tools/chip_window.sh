@@ -293,6 +293,24 @@ if [ "${DDL_SERVE_CHAOS:-0}" = "1" ]; then
   note serve_chaos
 fi
 
+# 10d. Serve fast path at a fixed SLO (gated, ask with DDL_SERVE_SPEC=1):
+# radix prefix cache + speculative decoding vs the features-off engine on
+# the same shared-prefix trace, capacity judged at a fixed p99 TTFT SLO
+# (docs/serving.md). Gated because the sweep runs BOTH arms at every
+# offered load in --slo-rates and its cost scales with rates x requests;
+# the record (speedup_at_slo, per-rate hit/acceptance counters) lands in
+# serve_fastpath.json and the last_serve sidecar for doctor.py.
+if [ "${DDL_SERVE_SPEC:-0}" = "1" ]; then
+  check_stop serve_spec
+  timeout 900 python tools/bench_serve.py --dtype bfloat16 \
+    --prefix-cache --spec-draft-model gpt_nano --spec-k 4 \
+    --shared-prefix-len 64 --tenants a,b --requests 32 \
+    --num-pages 256 --max-pages-per-slot 16 --prefill-buckets 16,128 \
+    --fixed-slo 0.5 \
+    > "$RES/serve_fastpath.json" 2>> "$RES/log.txt"
+  note serve_spec
+fi
+
 check_stop flash
 # 11. Flash-attention compiled-kernel validation (fwd/bwd err + timing).
 timeout 600 python tools/validate_flash_tpu.py \

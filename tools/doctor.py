@@ -354,6 +354,21 @@ def check_serve() -> None:
                     chaos.get("token_identity_checked"),
                 "chaos_leak_check_ok": chaos.get("leak_check_ok"),
             }
+        # Fast-path health: prefix reuse and speculative acceptance from
+        # the last bench window. A hit rate of 0 under a shared-prefix
+        # trace, or acceptance far below the drafter's usual, is a fast
+        # path that is configured but not paying for itself.
+        if cont.get("prefix_hit_rate") is not None:
+            extra["prefix_hit_rate"] = cont.get("prefix_hit_rate")
+            extra["prefix_tokens_reused"] = cont.get("prefix_tokens_reused")
+            extra["prefix_evictions"] = cont.get("prefix_evictions")
+            extra["cow_copies"] = cont.get("cow_copies")
+        if cont.get("spec_rounds"):
+            extra["spec_rounds"] = cont.get("spec_rounds")
+            extra["spec_acceptance_rate"] = cont.get("spec_acceptance_rate")
+        if rec.get("speedup_at_slo") is not None:
+            extra["speedup_at_slo"] = rec.get("speedup_at_slo")
+            extra["slo_p99_ttft_s"] = rec.get("slo_p99_ttft_s")
         emit("serve", ok=True,
              tokens_per_sec_per_chip=rec.get("value"),
              speedup_vs_sequential=rec.get("speedup_vs_sequential"),

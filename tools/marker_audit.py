@@ -177,9 +177,13 @@ def audit_serve(records) -> list[str]:
     silent-disarm failure modes: the marked tests vanish from the
     selection, or every one is also marked ``slow`` and tier-1's
     ``-m 'not slow'`` stops pinning engine token-identity against
-    sequential generate(). The serve_decode perf-gate workload
-    (tests/test_perf_gate.py) must also have run — losing it quietly
-    un-gates the engine's per-step cost."""
+    sequential generate(). The serve_decode AND serve_prefix_prefill
+    perf-gate workloads (tests/test_perf_gate.py) must also have run —
+    losing either quietly un-gates the engine's per-step or
+    admission-path cost — and the fast-path identity tests
+    (tests/test_serve_fastpath.py: prefix cache + speculative decoding
+    vs sequential generate()) must be present, or the COW/spec paths
+    regress to "configured but unproven"."""
     problems = []
     serve = [r for r in records if r.get("serve")]
     if not serve:
@@ -200,6 +204,22 @@ def audit_serve(records) -> list[str]:
             "the engine's decode-step cost is ungated "
             "(tests/test_perf_gate.py::test_perf_gate_live_serve_decode "
             "missing, renamed, or deselected?)")
+    if not any(r.get("perf_gate") and "serve_prefix" in (r.get("nodeid")
+                                                         or "")
+               for r in records):
+        problems.append(
+            "no perf_gate test covering the serve_prefix_prefill workload "
+            "ran — the prefix-cache admission path is ungated "
+            "(tests/test_perf_gate.py::"
+            "test_perf_gate_live_serve_prefix_prefill missing, renamed, "
+            "or deselected?)")
+    if serve and not any("fastpath" in (r.get("nodeid") or "")
+                         for r in serve):
+        problems.append(
+            "no serve-marked fast-path test ran — prefix-cache / "
+            "speculative-decoding token identity is unpinned "
+            "(tests/test_serve_fastpath.py missing, renamed, or "
+            "deselected?)")
     return problems
 
 

@@ -125,6 +125,22 @@ SUITE = (
     ("bert2048_flash", "bert_base", {"batch_size": 32, "seq_len": 2048,
                                      "attention_impl": "flash",
                                      "remat": True}, 180),
+    # Pipeline-schedule A/B (models/pipeline.py), after the value-per-minute
+    # prefix — chip windows reach these by NAME via the gated DDL_PIPELINE=1
+    # pipeline_ab step, never by budget order. Fill/drain GPipe vs
+    # interleaved 1f1b at IDENTICAL geometry — same model, batch, seq_len,
+    # stages (pp=2) and microbatches (M=4, registry); the ONLY delta is the
+    # schedule (1f1b adds V=2 virtual chunks per stage). Each record
+    # carries the measured pipeline_bubble_fraction from the trace-time
+    # tick instants next to the analytic (P-1)/(M*V+P-1), so the pair IS
+    # the bubble-kill verdict: 1f1b's measured bubble must land strictly
+    # below gpipe's and within 1.5x its analytic value (docs/pipeline.md).
+    ("pp_gpipe", "bert_tiny_pp4", {"batch_size": 4, "seq_len": 128,
+                                   "pp": 2, "pipeline_schedule": "gpipe",
+                                   "pipeline_virtual_stages": 1}, 90),
+    ("pp_1f1b", "bert_tiny_pp4", {"batch_size": 4, "seq_len": 128,
+                                  "pp": 2, "pipeline_schedule": "1f1b",
+                                  "pipeline_virtual_stages": 2}, 90),
 )
 
 
@@ -165,6 +181,15 @@ def _metric_name_unit(args) -> tuple[str, str]:
     stage = getattr(args, "optimizer_sharding", None)
     if stage and stage != "none":
         perleaf += f"_{stage}"
+    # Pipeline rows likewise: each (stages, schedule, virtual-stage) tuple
+    # is its own measurement protocol — the gpipe and 1f1b A/B rows must
+    # never evict each other's (or the non-pipelined model's) last-good
+    # entries under a shared key.
+    pp = getattr(args, "pp", 1) or 1
+    if pp > 1:
+        sched = getattr(args, "pipeline_schedule", "gpipe") or "gpipe"
+        vv = getattr(args, "pipeline_virtual_stages", 1) or 1
+        perleaf += f"_pp{pp}_{sched}" + (f"v{vv}" if vv > 1 else "")
     # Tracing adds per-step clock reads inside the timed window — protocol
     # drift by design (it's how the overhead A/B measures itself), so traced
     # numbers live under their own metric name and can never evict an
@@ -210,6 +235,10 @@ def _protocol_suffix(args) -> str:
             parts.append("no-overlap")
     if getattr(args, "opt_state_offload", False):
         parts.append("opt-offload")
+    pp = getattr(args, "pp", 1) or 1
+    if pp > 1:
+        parts.append(f"pp{pp}-{getattr(args, 'pipeline_schedule', 'gpipe')}"
+                     f"-v{getattr(args, 'pipeline_virtual_stages', 1) or 1}")
     if getattr(args, "trace_dir", None):
         parts.append("tele")
     return (" " + "+".join(parts)) if parts else ""
@@ -329,6 +358,18 @@ def _child_measure(args, emit_quick: bool = True,
                                    process_index=jax.process_index(),
                                    process_name="bench")
 
+    # Pipeline rows: the measured bubble comes from the trace-time
+    # pipeline_tick instants (models/pipeline.py), so a buffer-only
+    # registry captures them without adding clock reads to the timed
+    # windows — the metric keeps its untraced protocol (no _tele drift).
+    # A --trace-dir run reuses its own registry instead.
+    pp = getattr(args, "pp", 1) or 1
+    pipe_tele = tele
+    if pp > 1 and pipe_tele is None:
+        pipe_tele = telemetry.configure(enabled=True,
+                                        process_index=jax.process_index(),
+                                        process_name="bench")
+
     n_dev = jax.device_count()
     spec = model_spec(args.model)
     tokens = spec.input_kind == "tokens"
@@ -352,13 +393,20 @@ def _child_measure(args, emit_quick: bool = True,
         fused_bn=args.fused_bn,
         fused_block=args.fused_block,
         fused_conv3=getattr(args, "fused_conv3", False),
-        parallel=ParallelConfig(data=n_dev),
+        parallel=ParallelConfig(data=max(1, n_dev // pp), pipeline=pp),
         data=data,
         allreduce=AllReduceConfig(**ar_kw),
         optimizer_sharding=(getattr(args, "optimizer_sharding", None)
                             or "none"),
         overlap_collectives=getattr(args, "overlap_collectives", True),
-        opt_state_offload=getattr(args, "opt_state_offload", False))
+        opt_state_offload=getattr(args, "opt_state_offload", False),
+        pipeline_schedule=(getattr(args, "pipeline_schedule", None)
+                           or "gpipe"),
+        pipeline_virtual_stages=(getattr(args, "pipeline_virtual_stages", 1)
+                                 or 1))
+    if pp > 1 and n_dev % pp:
+        raise ValueError(f"pipeline stages {pp} must divide the device "
+                         f"count {n_dev}")
 
     quick_w = (args.warmup_steps if args.warmup_steps is not None
                else args.quick_warmup)
@@ -408,6 +456,33 @@ def _child_measure(args, emit_quick: bool = True,
                 mem[key] = int(stats[key])
     except Exception:
         pass  # annotation only — never costs a measurement
+    # Pipeline A/B annotation: measured bubble (idle / total stage-ticks
+    # over the trace-time tick instants; null on an AOT cache hit that
+    # skipped tracing) next to the schedule table's analytic value — the
+    # pair the gpipe-vs-1f1b acceptance check reads (docs/pipeline.md).
+    pipe = {}
+    if pp > 1 and pipe_tele is not None:
+        bub = telemetry.pipeline_bubble_fraction(pipe_tele.snapshot())
+        pipe_rec = {"stages": pp, "schedule": cfg.pipeline_schedule,
+                    "virtual_stages": cfg.pipeline_virtual_stages,
+                    "bubble_fraction": None if bub is None
+                    else round(bub, 4)}
+        try:
+            from distributeddeeplearning_tpu.models import pipeline as plib
+            ticks = [e for e in pipe_tele.snapshot()
+                     if e.get("name") == "pipeline_tick"]
+            mm = int(ticks[0]["args"]["microbatches"]) if ticks else 0
+            if mm:
+                pipe_rec["microbatches"] = mm
+                pipe_rec["analytic_bubble_fraction"] = round(
+                    plib.build_schedule(
+                        cfg.pipeline_schedule, num_stages=pp,
+                        num_microbatches=mm,
+                        virtual_stages=cfg.pipeline_virtual_stages,
+                    ).analytic_bubble_fraction(), 4)
+        except Exception:
+            pass  # annotation only
+        pipe["pipeline"] = pipe_rec
     def timed_window(n_steps: int):
         """Dispatch up to n_steps; returns (steps_done, elapsed).
 
@@ -470,8 +545,8 @@ def _child_measure(args, emit_quick: bool = True,
         """Per-line annotations: memory + cold-start, plus (traced rows)
         the phase breakdown aggregated from the buffered spans so far."""
         if tele is None:
-            return {**mem, **cold}
-        return {**mem, **cold,
+            return {**mem, **cold, **pipe}
+        return {**mem, **cold, **pipe,
                 "phases": telemetry.phase_totals(tele.snapshot())}
 
     # Protocol marker: chunked barriers are measurement-protocol drift vs
@@ -636,6 +711,8 @@ def _child(args) -> int:
         row.allreduce_bucket_mb = row.allreduce_dtype = None
         row.optimizer_sharding = None
         row.overlap_collectives, row.opt_state_offload = True, False
+        row.pp, row.pipeline_schedule = 1, "gpipe"
+        row.pipeline_virtual_stages = 1
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -1137,6 +1214,23 @@ def main(argv=None) -> int:
                         "backward instead of issuing them per fusion "
                         "bucket as cotangents are produced (A/B for the "
                         "overlap win; marked no-overlap in the protocol)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (models/pipeline.py); must divide "
+                        "the device count, remaining devices become the "
+                        "data axis; the model must be a *_pp registry "
+                        "variant with matching pipeline_stages")
+    p.add_argument("--pipeline-schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe = fill/drain, 1f1b = "
+                        "interleaved one-forward-one-backward over "
+                        "--pipeline-virtual-stages chunks per stage; each "
+                        "(stages, schedule, V) tuple reports under its own "
+                        "metric name and records carry the measured "
+                        "pipeline_bubble_fraction (docs/pipeline.md)")
+    p.add_argument("--pipeline-virtual-stages", type=int, default=1,
+                   help="virtual chunks per stage for --pipeline-schedule "
+                        "1f1b (V>1 shrinks the bubble to "
+                        "(P-1)/(M*V+P-1)); must divide layers-per-stage")
     p.add_argument("--opt-state-offload", action="store_true",
                    help="place sharded optimizer-state chunks in host RAM "
                         "(pinned_host memory kind) where the backend "
@@ -1251,6 +1345,16 @@ def main(argv=None) -> int:
         p.error(f"--allreduce-bucket-mb must be >= 0 "
                 f"(got {args.allreduce_bucket_mb}); 0 selects per-leaf "
                 f"reduction")
+    # Same up-front rejects as train.py / models/pipeline.build_schedule:
+    # a malformed schedule must die at parse time, not after backend init.
+    if args.pp < 1:
+        p.error(f"--pp must be >= 1 (got {args.pp})")
+    if args.pipeline_virtual_stages < 1:
+        p.error(f"--pipeline-virtual-stages must be >= 1 "
+                f"(got {args.pipeline_virtual_stages})")
+    if args.pipeline_virtual_stages > 1 and args.pipeline_schedule != "1f1b":
+        p.error("--pipeline-virtual-stages > 1 requires "
+                "--pipeline-schedule 1f1b (gpipe has no virtual chunks)")
     try:  # fail a malformed --sweep at parse time, not after the primary
         _sweep_batches(args)
     except ValueError:
@@ -1330,6 +1434,13 @@ def main(argv=None) -> int:
         child_cmd += ["--no-overlap-collectives"]
     if args.opt_state_offload:
         child_cmd += ["--opt-state-offload"]
+    if args.pp > 1:
+        child_cmd += ["--pp", str(args.pp)]
+    if args.pipeline_schedule != "gpipe":
+        child_cmd += ["--pipeline-schedule", args.pipeline_schedule]
+    if args.pipeline_virtual_stages != 1:
+        child_cmd += ["--pipeline-virtual-stages",
+                      str(args.pipeline_virtual_stages)]
     if args.trace_dir:
         child_cmd += ["--trace-dir", args.trace_dir]
     if args.compile_cache_dir is not None:

@@ -24,6 +24,15 @@ artifact:
   the deterministic plan ``parallel/collectives.plan_buckets`` promises:
   one ``psum`` (or ``reduce_scatter``+``all_gather`` ring pair) per
   fusion bucket, in sorted-path bucket order.
+- :func:`verify_pipeline_pairing` (rule ``pipeline-schedule-pairing``)
+  checks a pipeline schedule table (``models/pipeline.build_schedule``)
+  for the MPMD divergent-schedule deadlock class: every stage's
+  occupancy must be fed by a matching collective-permute edge in the
+  same tick's shift, source/target pairs must form a partial
+  permutation, and the ring wrap must never collide with an injection.
+  :func:`permute_schedule` renders the table's per-tick shift pairs as
+  a first-class :class:`Schedule` so it fingerprints like any traced
+  program.
 - :func:`check_aot_pairing` records (config fingerprint -> schedule
   fingerprint) pairs in a sidecar registry and flags any config
   fingerprint that maps to two different schedules — the invariant that
@@ -79,6 +88,9 @@ class CollectiveOp:
     shape: Optional[tuple[int, ...]] = None
     dtype: Optional[str] = None
     note: Optional[str] = None              # e.g. unknown custom-call target
+    pairs: Optional[tuple] = None           # ppermute (src, dst) pairs —
+                                            # jaxpr `perm` / HLO
+                                            # source_target_pairs
 
     def describe(self) -> str:
         where = (",".join(self.axes) if self.axes
@@ -86,6 +98,8 @@ class CollectiveOp:
         payload = (f"{self.dtype or '?'}{list(self.shape)}"
                    if self.shape is not None else "?")
         extra = f" [{self.note}]" if self.note else ""
+        if self.pairs is not None:
+            extra = f" pairs={list(map(list, self.pairs))}" + extra
         return f"{self.kind}({where}, {payload}){extra}"
 
     def canonical(self) -> dict:
@@ -169,10 +183,17 @@ def extract_from_jaxpr(jaxpr_like: Any) -> Schedule:
                     axes = _normalize_axes(params.get("axes")
                                            if "axes" in params
                                            else params.get("axis_name"))
+                    pairs = None
+                    if kind == "ppermute" and params.get("perm") is not None:
+                        # The (source, target) pairs ARE the schedule for a
+                        # permute — two stage programs that disagree here
+                        # park forever (pipeline-schedule-pairing class).
+                        pairs = tuple((int(a), int(b))
+                                      for a, b in params["perm"])
                     aval = getattr(eqn.invars[0], "aval", None) \
                         if eqn.invars else None
                     ops.append(CollectiveOp(
-                        kind=kind, axes=axes,
+                        kind=kind, axes=axes, pairs=pairs,
                         shape=(tuple(int(d) for d in aval.shape)
                                if aval is not None else None),
                         dtype=(str(aval.dtype) if aval is not None
@@ -266,6 +287,17 @@ def extract_from_hlo_text(text: str) -> Schedule:
                 if groups is None:
                     errors.append(f"line {n}: replica_groups torn "
                                   f"mid-brace; op kept without groups")
+            pairs = None
+            pi = line.find("source_target_pairs=")
+            if pi >= 0:
+                blob = _balanced_braces(line, line.find("{", pi))
+                if blob is None:
+                    errors.append(f"line {n}: source_target_pairs torn "
+                                  f"mid-brace; op kept without pairs")
+                else:
+                    pairs = tuple(
+                        (int(a), int(b))
+                        for a, b in re.findall(r"\{(\d+),(\d+)\}", blob))
             shape = None
             if m.group("dims") is not None:
                 dims = m.group("dims")
@@ -280,15 +312,15 @@ def extract_from_hlo_text(text: str) -> Schedule:
                     # collective we cannot see — record, note, move on.
                     ops.append(CollectiveOp(
                         kind="custom-call", groups=groups, shape=shape,
-                        dtype=m.group("dtype"),
+                        dtype=m.group("dtype"), pairs=pairs,
                         note=f"unknown target {target!r} (tolerated)"))
                     continue
                 ops.append(CollectiveOp(kind=f"custom-call:{target}",
                                         groups=groups, shape=shape,
-                                        dtype=m.group("dtype")))
+                                        dtype=m.group("dtype"), pairs=pairs))
                 continue
             ops.append(CollectiveOp(kind=op, groups=groups, shape=shape,
-                                    dtype=m.group("dtype")))
+                                    dtype=m.group("dtype"), pairs=pairs))
         except Exception as exc:  # noqa: BLE001 — torn lines are expected
             errors.append(f"line {n} unreadable "
                           f"({type(exc).__name__}: {exc})")
@@ -342,6 +374,119 @@ def verify_bucket_schedule(schedule: Schedule, plan, algorithm: str,
         f"bucket schedule mismatch vs parallel/collectives planner: "
         f"expected {len(plan.buckets)} bucket(s) x {per_bucket} = "
         f"{expected}, traced program issues {got}")]
+
+
+def permute_schedule(pipeline_schedule) -> Schedule:
+    """The activation-shift collective-permute sequence of one pipeline
+    schedule table (``models/pipeline.build_schedule``) as a first-class
+    :class:`Schedule`: one ``ppermute`` over the ``pipeline`` axis per
+    tick, carrying that tick's (source, target) pairs. This is the
+    schedule a per-stage MPMD program would have to issue verbatim — it
+    fingerprints like a traced program, so ddl-lint can record it and
+    bench records can name the shift pattern they measured under."""
+    ops = tuple(
+        CollectiveOp(kind="ppermute", axes=("pipeline",),
+                     pairs=pipeline_schedule.shift_pairs(t.index))
+        for t in pipeline_schedule.ticks)
+    return Schedule(ops=ops, source=f"pipeline:{pipeline_schedule.name}")
+
+
+def verify_pipeline_pairing(label: str, sched) -> list[dict]:
+    """Rule ``pipeline-schedule-pairing``: the MPMD divergent-schedule
+    deadlock class, checked on the host-side tick table before any trace.
+
+    ``sched`` is a ``models/pipeline.PipelineSchedule`` (duck-typed:
+    ``num_stages``/``num_microbatches``/``virtual_stages``, ``ticks``
+    with ``occupancy``/``inject_mb``/``emit_mb``, ``shift_pairs``).
+    Each stage's program is generated from this one table; the checks
+    below are exactly the ways independently-generated per-stage views
+    can disagree and park a rank on a permute forever:
+
+    - pairs must form a partial permutation (no stage sends or receives
+      twice in one shift) over real stage ids;
+    - the ring wrap (P-1, 0) must be absent on inject ticks — stage 0
+      cannot take the wrap and a fresh microbatch in the same shift;
+    - dataflow continuity: work at stage k tick t must have sat at the
+      predecessor stage at tick t-1 (or been injected), and the shift
+      entering tick t must carry the matching (src, k) edge — a missing
+      edge is a receive with no matching send;
+    - emission/injection bookkeeping: ``emit_mb`` fires exactly when the
+      last stage finishes the last chunk, and every microbatch is
+      injected and emitted exactly once.
+    """
+    findings: list[dict] = []
+    p = sched.num_stages
+    v = getattr(sched, "virtual_stages", 1)
+
+    def fail(msg: str) -> None:
+        findings.append(finding(
+            "collectives", "pipeline-schedule-pairing", f"{label}: {msg}"))
+
+    prev_occ = (None,) * p
+    for t, tick in enumerate(sched.ticks):
+        try:
+            pairs = tuple(tuple(e) for e in sched.shift_pairs(tick.index))
+        except Exception as exc:  # noqa: BLE001 — report, keep linting
+            fail(f"tick {t}: shift_pairs unreadable "
+                 f"({type(exc).__name__}: {exc})")
+            break
+        srcs = [e[0] for e in pairs]
+        dsts = [e[1] for e in pairs]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            fail(f"tick {t}: permute pairs {pairs} are not a partial "
+                 f"permutation — some stage must send or receive twice "
+                 f"in one shift")
+        bad = [e for e in pairs
+               if not (0 <= e[0] < p and 0 <= e[1] < p)]
+        if bad:
+            fail(f"tick {t}: permute pairs {bad} name stages outside "
+                 f"0..{p - 1}")
+        pair_set = set(pairs)
+        if tick.inject_mb is not None and (p - 1, 0) in pair_set:
+            fail(f"tick {t}: wrap pair ({p - 1}, 0) scheduled on an "
+                 f"inject tick — stage 0 would receive the ring wrap and "
+                 f"the fresh microbatch in the same shift")
+        for k, occ in enumerate(tick.occupancy):
+            if occ is None:
+                continue
+            mb, c = occ
+            if k == 0 and c == 0:
+                if tick.inject_mb != mb:
+                    fail(f"tick {t}: stage 0 works microbatch {mb} chunk "
+                         f"0 but inject_mb={tick.inject_mb} — its input "
+                         f"was never injected")
+                continue
+            src = k - 1 if k else p - 1
+            want = (mb, c) if k else (mb, c - 1)
+            if (src, k) not in pair_set:
+                fail(f"tick {t}: stage {k} needs microbatch/chunk {want} "
+                     f"from stage {src} but the shift carries no "
+                     f"({src}, {k}) pair — stage {k} waits on a send "
+                     f"stage {src}'s program never issues")
+            if t == 0 or prev_occ[src] != want:
+                held = prev_occ[src] if t else None
+                fail(f"tick {t}: stage {k} expects {want} from stage "
+                     f"{src} but stage {src} held {held} at tick "
+                     f"{t - 1} — per-stage schedules disagree")
+        tail = tick.occupancy[p - 1]
+        want_emit = (tail[0] if tail is not None and tail[1] == v - 1
+                     else None)
+        if tick.emit_mb != want_emit:
+            fail(f"tick {t}: emit_mb={tick.emit_mb} but stage {p - 1} "
+                 f"holds {tail} (expected emit {want_emit})")
+        prev_occ = tick.occupancy
+    m = sched.num_microbatches
+    injected = sorted(t.inject_mb for t in sched.ticks
+                      if t.inject_mb is not None)
+    emitted = sorted(t.emit_mb for t in sched.ticks
+                     if t.emit_mb is not None)
+    if injected != list(range(m)):
+        fail(f"injection covers {injected}, expected each of 0..{m - 1} "
+             f"exactly once")
+    if emitted != list(range(m)):
+        fail(f"emission covers {emitted}, expected each of 0..{m - 1} "
+             f"exactly once")
+    return findings
 
 
 def plan_is_deterministic(tree_builder, plan_buckets, *,

@@ -260,6 +260,16 @@ class TrainConfig:
     # bubble wastes (P-1)/(M+P-1) of every stage-tick; M >= 4(P-1) keeps it
     # under ~20% (tools/bench_parallel_overhead.py measures this).
     pipeline_microbatches: Optional[int] = None
+    pipeline_schedule: str = "gpipe"  # "gpipe" (fill/drain) or "1f1b"
+                                  # (interleaved virtual stages, bubble
+                                  # (P-1)/(M*V+P-1) — models/pipeline.py,
+                                  # docs/pipeline.md). Both compile to one
+                                  # XLA program; the fingerprint keeps
+                                  # their AOT executables apart
+    pipeline_virtual_stages: int = 1  # V chunks per stage under 1f1b; each
+                                  # extra chunk divides the bubble at the
+                                  # cost of V x more in-flight activation
+                                  # shifts per microbatch
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)

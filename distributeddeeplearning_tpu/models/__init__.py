@@ -142,6 +142,16 @@ def _registry() -> dict[str, ModelSpec]:
                 **{"pipeline_stages": 2,
                    "pipeline_microbatches": 4, **kw}),
             input_kind="tokens", param_count=0),
+        # 4-layer variant: layers_per_stage=2 admits interleaved 1f1b with
+        # pipeline_virtual_stages=2 — the schedule A/B geometry used by
+        # tests/test_pipeline.py, bench.py and the pipeline_1f1b perf-gate
+        # workload.
+        "bert_tiny_pp4": ModelSpec(
+            name="bert_tiny_pp4", objective="mlm",
+            build=lambda **kw: bert.tiny_bert_mlm(
+                **{"num_layers": 4, "pipeline_stages": 2,
+                   "pipeline_microbatches": 4, **kw}),
+            input_kind="tokens", param_count=0),
     }
 
 

@@ -50,11 +50,15 @@ class BertConfig:
     moe_top_k: int = 1              # 1 = Switch, 2 = GShard routing
     moe_aux_weight: float = 0.01
     # Pipeline parallelism: pipeline_stages > 1 runs the encoder stack as a
-    # GPipe schedule over the ``pipeline`` mesh axis (models/pipeline.py);
+    # pipeline schedule over the ``pipeline`` mesh axis (models/pipeline.py);
     # num_layers must divide evenly into stages. Incompatible with MoE
-    # layers (the stages must be homogeneous).
+    # layers (the stages must be homogeneous). Schedule "gpipe" is
+    # fill/drain; "1f1b" interleaves pipeline_virtual_stages chunks per
+    # stage to shrink the bubble (docs/pipeline.md).
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    pipeline_schedule: str = "gpipe"
+    pipeline_virtual_stages: int = 1
     # Rematerialization: recompute each encoder layer's activations in the
     # backward pass instead of storing them — trades ~1/3 more FLOPs for
     # O(num_layers) less activation HBM (the long-context/deep-model knob).
@@ -199,6 +203,8 @@ class BertMLM(nn.Module):
                 functools.partial(EncoderLayer, cfg, self.dtype),
                 num_layers=cfg.num_layers, num_stages=cfg.pipeline_stages,
                 num_microbatches=cfg.pipeline_microbatches,
+                schedule=cfg.pipeline_schedule,
+                virtual_stages=cfg.pipeline_virtual_stages,
                 remat=cfg.remat, dtype=self.dtype)(
                     x, attention_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
@@ -265,7 +271,8 @@ def bert_large_mlm(vocab_size: int = 30522, dtype: Dtype = jnp.bfloat16,
 def tiny_bert_mlm(vocab_size: int = 1024, dtype: Dtype = jnp.float32,
                   seq_len: Optional[int] = None, **overrides: Any) -> BertMLM:
     """Test-sized BERT (used by unit tests and dryrun_multichip)."""
-    cfg = BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
-                     num_heads=4, intermediate_size=128,
-                     **{"max_position": 128, **overrides})
+    cfg = BertConfig(vocab_size=vocab_size,
+                     **{"hidden_size": 64, "num_layers": 2, "num_heads": 4,
+                        "intermediate_size": 128, "max_position": 128,
+                        **overrides})
     return BertMLM(_fit_positions(cfg, seq_len), dtype=dtype)

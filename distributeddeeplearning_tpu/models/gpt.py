@@ -41,10 +41,13 @@ class GptConfig:
                                     # ring (causal ring over the `seq` axis) |
                                     # zigzag (load-balanced causal ring)
     remat: bool = False
-    # GPipe pipeline over the `pipeline` mesh axis (models/pipeline.py);
-    # num_layers must divide evenly into stages.
+    # Pipeline over the `pipeline` mesh axis (models/pipeline.py);
+    # num_layers must divide evenly into stages. Schedule "gpipe" or
+    # interleaved "1f1b" with pipeline_virtual_stages chunks per stage.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    pipeline_schedule: str = "gpipe"
+    pipeline_virtual_stages: int = 1
 
     @property
     def intermediate_size(self) -> int:
@@ -279,6 +282,8 @@ class GptLM(nn.Module):
                 functools.partial(DecoderBlock, cfg, self.dtype),
                 num_layers=cfg.num_layers, num_stages=cfg.pipeline_stages,
                 num_microbatches=cfg.pipeline_microbatches,
+                schedule=cfg.pipeline_schedule,
+                virtual_stages=cfg.pipeline_virtual_stages,
                 remat=cfg.remat, dtype=self.dtype)(
                     x, pad_mask, deterministic=deterministic)
             x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))

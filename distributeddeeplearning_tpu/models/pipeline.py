@@ -3,8 +3,8 @@ ParallelConfig.pipeline — VERDICT r1 "dead config" item).
 
 TPU-first design (the GSPMD pipelining pattern used by production JAX LLM
 stacks): instead of per-stage processes exchanging activations (the
-GPU/NCCL shape of pipeline parallelism), the whole GPipe schedule is ONE
-XLA program —
+GPU/NCCL shape of pipeline parallelism), the whole schedule is ONE XLA
+program —
 
 - encoder layers are created with ``nn.vmap``(stages) of ``nn.scan``(layers
   per stage), so every layer parameter has a leading ``(num_stages,
@@ -14,38 +14,198 @@ XLA program —
   each stage is working on, sharded over ``pipeline`` on dim 0;
 - each schedule tick applies all stages at once (the vmapped chunk — each
   stage's compute lands on that stage's devices) and then *shifts* the
-  buffer one stage forward, injecting the next microbatch at stage 0. XLA
-  lowers the shift of a pipeline-sharded buffer to a ``collective-permute``
-  over ICI — the TPU-native replacement for point-to-point activation sends.
+  buffer one stage forward. XLA lowers the shift of a pipeline-sharded
+  buffer to a ``collective-permute`` over ICI — the TPU-native replacement
+  for point-to-point activation sends.
 
-The classic GPipe bubble (stages idle for P-1 of the M+P-1 ticks) applies;
-choose ``num_microbatches >> num_stages`` to amortize it.
+Two schedules, both generated from one host-side table (PipelineSchedule):
+
+``gpipe``  — fill/drain: microbatch m enters stage 0 at tick m, exits stage
+  P-1 at tick m+P-1; M+P-1 ticks total, bubble (P-1)/(M+P-1).
+
+``1f1b``   — interleaved virtual stages (the MPMD 1F1B pattern, arXiv
+  2412.14374): each stage holds V *virtual chunks* of layers_per_stage/V
+  layers; activations travel the stage ring V times (the shift becomes a
+  *circular* permute, P-1 -> 0 wraps), so microbatches re-enter stage 0 at
+  deeper chunks while younger microbatches are still filling. Microbatches
+  are injected in groups of P — microbatch m = g*P + j enters at tick
+  g*P*V + j — which interleaves the steady state exactly one microbatch
+  deep per stage per tick. M*V + P - 1 ticks total for P | M, bubble
+  (P-1)/(M*V+P-1): the same P-1 fill/drain ticks amortized over V times
+  more work-ticks. V=1 degenerates to the GPipe occupancy.
+
+Occupancy is closed-form: stage k at tick t works on chunk
+``((t-k)//P) mod V`` of microbatch ``((t-k)//(P*V))*P + (t-k)%P`` (valid
+when t >= k and the microbatch index is < M). At any fixed tick the chunk
+index takes at most two distinct values across stages (boundary at
+k = t mod P), so per-tick chunk selection is a static slice + masked
+select — no dynamic gather, and the Python tick loop stays static.
+
+Parameter layout is schedule-invariant: the canonical checkpoint layout is
+the GPipe stage-major one (stage k's row holds global layers
+[k*layers_per_stage, (k+1)*layers_per_stage)). The 1F1B traversal visits
+layer blocks in stage-minor order, so the interleaved apply re-lays the
+layer dim once per call (a static reshape/transpose; one cross-stage
+shuffle under GSPMD) — checkpoints, the sharding spec, and cross-schedule
+resume all see one layout. Gradients for a weight chunk accumulate in-place
+across the microbatches that visit it, as autodiff of the tick loop.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import dataclasses
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
 
+SCHEDULES = ("gpipe", "1f1b")
+
+
+# ---------------------------------------------------------------------------
+# Host-side schedule table — single source of truth for the tick loop, the
+# measured bubble gauge (observability/telemetry.pipeline_bubble_fraction)
+# and the ddl-lint pairing rule (analysis/collectives.py).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class StageTick:
+    """One schedule tick: per-stage occupancy and the shift that precedes
+    the compute. ``occupancy[k]`` is ``(microbatch, chunk)`` or None when
+    stage k idles this tick; ``chunks[k]`` is the chunk whose parameters
+    stage k applies (defined for idle stages too — they compute on dead
+    state that is never read, exactly like GPipe's drain phase)."""
+
+    index: int
+    occupancy: tuple
+    chunks: tuple
+    inject_mb: Optional[int]
+    emit_mb: Optional[int]
+
+    @property
+    def idle_stages(self) -> int:
+        return sum(1 for o in self.occupancy if o is None)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSchedule:
+    """The full tick table for one (schedule, P, M, V) geometry."""
+
+    name: str
+    num_stages: int
+    num_microbatches: int
+    virtual_stages: int
+    ticks: tuple
+
+    @property
+    def num_ticks(self) -> int:
+        return len(self.ticks)
+
+    def bubble_fraction(self) -> float:
+        """Idle stage-ticks / total stage-ticks of the executed table. For
+        P | M this equals the analytic (P-1)/(M*V+P-1)."""
+        total = self.num_stages * self.num_ticks
+        idle = sum(t.idle_stages for t in self.ticks)
+        return idle / total if total else 0.0
+
+    def analytic_bubble_fraction(self) -> float:
+        p, m, v = self.num_stages, self.num_microbatches, self.virtual_stages
+        return (p - 1) / (m * v + p - 1)
+
+    def shift_pairs(self, tick_index: int) -> tuple:
+        """(source, target) collective-permute pairs of the activation shift
+        entering tick ``tick_index``. The forward ring is k -> k+1; the
+        wrap pair (P-1, 0) exists only when stage 0 *receives* from the ring
+        (a 1F1B chunk re-entry) rather than taking a fresh microbatch — on
+        inject ticks row 0 is overwritten, so the wrap edge carries no
+        data. Every stage's view of this list must be identical; divergence
+        is the MPMD deadlock class ddl-lint's pipeline-schedule-pairing
+        rule rejects."""
+        p = self.num_stages
+        pairs = [(k, k + 1) for k in range(p - 1)]
+        if self.ticks[tick_index].inject_mb is None:
+            pairs.append((p - 1, 0))
+        return tuple(pairs)
+
+
+def build_schedule(name: str, *, num_stages: int, num_microbatches: int,
+                   virtual_stages: int = 1) -> PipelineSchedule:
+    """Generate the tick table. Both schedules come from the one closed-form
+    occupancy above; gpipe is the V=1 special case with no wrap traffic."""
+    p, m, v = num_stages, num_microbatches, virtual_stages
+    if name not in SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {name!r}; expected one of "
+            f"{SCHEDULES}")
+    if v < 1:
+        raise ValueError(f"pipeline_virtual_stages={v} must be >= 1")
+    if name == "gpipe" and v != 1:
+        raise ValueError(
+            f"schedule='gpipe' runs one chunk per stage; "
+            f"pipeline_virtual_stages={v} requires schedule='1f1b'")
+    if v > 1 and m % p:
+        raise ValueError(
+            f"schedule='1f1b' injects microbatches in groups of "
+            f"num_stages: num_microbatches={m} must be divisible by "
+            f"num_stages={p}")
+    # Last microbatch M-1 = g*P + j enters at g*P*V + j and finishes
+    # P*V - 1 ticks later.
+    last_g, last_j = divmod(m - 1, p)
+    num_ticks = last_g * p * v + last_j + p * v
+    ticks = []
+    for t in range(num_ticks):
+        occ, chunks = [], []
+        for k in range(p):
+            q, j = divmod(t - k, p)          # floor semantics for t < k
+            chunk = q % v
+            mb = (q // v) * p + j
+            valid = t >= k and 0 <= mb < m
+            occ.append((mb, chunk) if valid else None)
+            chunks.append(chunk)
+        inject = occ[0][0] if occ[0] is not None and occ[0][1] == 0 else None
+        emit = (occ[p - 1][0]
+                if occ[p - 1] is not None and occ[p - 1][1] == v - 1
+                else None)
+        ticks.append(StageTick(index=t, occupancy=tuple(occ),
+                               chunks=tuple(chunks), inject_mb=inject,
+                               emit_mb=emit))
+    return PipelineSchedule(name=name, num_stages=p, num_microbatches=m,
+                            virtual_stages=v, ticks=tuple(ticks))
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
 
 def build_pipelined(layer_factory, *, num_layers: int, num_stages: int,
-                    num_microbatches: int, remat: bool = False,
+                    num_microbatches: int, schedule: str = "gpipe",
+                    virtual_stages: int = 1, remat: bool = False,
                     dtype: Dtype = jnp.bfloat16,
                     name: str = "pipeline") -> "PipelinedEncoder":
     """Shared model-side wiring (BERT and GPT use identical logic): validate
-    the stage split and construct the pipelined encoder."""
+    the stage/chunk split and construct the pipelined encoder."""
     if num_layers % num_stages:
         raise ValueError(
             f"num_layers={num_layers} not divisible by "
             f"pipeline_stages={num_stages}")
+    layers_per_stage = num_layers // num_stages
+    if layers_per_stage % virtual_stages:
+        raise ValueError(
+            f"layers_per_stage={layers_per_stage} not divisible by "
+            f"pipeline_virtual_stages={virtual_stages}")
+    # Validate the (schedule, V) pairing eagerly — a bad combination should
+    # fail at model build, not first trace.
+    build_schedule(schedule, num_stages=num_stages,
+                   num_microbatches=num_microbatches,
+                   virtual_stages=virtual_stages)
     return PipelinedEncoder(
         layer_factory=layer_factory, num_stages=num_stages,
-        layers_per_stage=num_layers // num_stages,
-        num_microbatches=num_microbatches, remat=remat, dtype=dtype,
+        layers_per_stage=layers_per_stage,
+        num_microbatches=num_microbatches, schedule=schedule,
+        virtual_stages=virtual_stages, remat=remat, dtype=dtype,
         name=name)
 
 
@@ -71,69 +231,149 @@ class _LayerStep(nn.Module):
 
 
 class PipelinedEncoder(nn.Module):
-    """Runs ``num_stages * layers_per_stage`` transformer layers as a GPipe
-    pipeline. ``layer_factory(name=...)`` must build one encoder layer
-    module with signature (x, mask, deterministic=...) -> x — e.g. a partial
-    of bert.EncoderLayer.
+    """Runs ``num_stages * layers_per_stage`` transformer layers as a
+    schedule-table-driven pipeline (gpipe or interleaved 1f1b).
+    ``layer_factory(name=...)`` must build one encoder layer module with
+    signature (x, mask, deterministic=...) -> x — e.g. a partial of
+    bert.EncoderLayer.
     """
 
     layer_factory: Callable[..., nn.Module]
     num_stages: int
     layers_per_stage: int
     num_microbatches: int
+    schedule: str = "gpipe"
+    virtual_stages: int = 1
     remat: bool = False
     dtype: Dtype = jnp.bfloat16
 
+    def _stacked_cls(self, scan_length: int):
+        """vmap(stages) of scan(layers): the stage dim carries the
+        ``layers`` logical axis -> ``pipeline`` mesh axis, the scan dim the
+        replicated ``layers_chunk`` axis."""
+        chunk = nn.scan(
+            _LayerStep,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=scan_length,
+            metadata_params={nn.PARTITION_NAME: "layers_chunk"})
+        return nn.vmap(
+            chunk,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=((0, 0), None), out_axes=((0, 0), None),
+            metadata_params={nn.PARTITION_NAME: "layers"})
+
     @nn.compact
     def __call__(self, x, mask, *, deterministic: bool):
-        p, m = self.num_stages, self.num_microbatches
+        p, m, v = self.num_stages, self.num_microbatches, self.virtual_stages
         b, s, h = x.shape
         if b % m:
             raise ValueError(
                 f"batch {b} not divisible by num_microbatches={m}")
         mb = b // m
 
-        # Inner: scan over one stage's layers (params stacked on the
-        # replicated "layers_chunk" dim). Outer: vmap over stages (params
-        # and activations stacked on "layers" -> `pipeline` mesh axis).
-        chunk = nn.scan(
-            _LayerStep,
-            variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-            length=self.layers_per_stage,
-            metadata_params={nn.PARTITION_NAME: "layers_chunk"})
-        stages_cls = nn.vmap(
-            chunk,
-            variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-            in_axes=((0, 0), None), out_axes=((0, 0), None),
-            metadata_params={nn.PARTITION_NAME: "layers"})
-        stages = stages_cls(self.layer_factory, deterministic,
-                            remat=self.remat, name="stages")
-
         micro = x.reshape(m, mb, s, h)
         micro_mask = mask.reshape(m, mb, s)
         state = jnp.zeros((p, mb, s, h), x.dtype)
         state_mask = jnp.ones((p, mb, s), mask.dtype)
-        zeros_in = jnp.zeros_like(micro[0])
+
+        if self.is_initializing():
+            # Parameter creation: one bound call of the full stack defines
+            # the canonical (num_stages, layers_per_stage, ...) tree — the
+            # same tree for every schedule, so checkpoints and the sharding
+            # spec are schedule-portable. The returned activations are
+            # shape-correct placeholders; init output values feed nothing
+            # but downstream *shapes*.
+            stages = self._stacked_cls(self.layers_per_stage)(
+                self.layer_factory, deterministic, remat=self.remat,
+                name="stages")
+            (state, state_mask), _ = stages((state, state_mask), None)
+            del state, state_mask
+            return jnp.zeros((b, s, h), x.dtype)
+
+        sched = build_schedule(self.schedule, num_stages=p,
+                               num_microbatches=m, virtual_stages=v)
+        lc = self.layers_per_stage // v
+        full = nn.meta.unbox(self.get_variable("params", "stages"))
+        if v > 1:
+            full = jax.tree_util.tree_map(self._interleave, full)
+
+        chunk_mod = self._stacked_cls(lc)(
+            self.layer_factory, deterministic, remat=self.remat)
+
+        from distributeddeeplearning_tpu.observability import telemetry
+        tele = telemetry.get()
 
         outputs = []
-        # M + P - 1 schedule ticks; the Python loop is static and short, and
-        # keeps stage-0 injection a pure concatenate.
-        for t in range(m + p - 1):
-            inject = micro[t] if t < m else zeros_in
-            inject_mask = micro_mask[t] if t < m else micro_mask[m - 1]
-            # Shift the pipeline: stage k takes stage k-1's output; stage 0
-            # takes the next microbatch. XLA: collective-permute over ICI.
-            state = jnp.concatenate([inject[None], state[:-1]], axis=0)
-            state_mask = jnp.concatenate(
-                [inject_mask[None], state_mask[:-1]], axis=0)
+        # Static Python tick loop, codegen'd from the table. The per-tick
+        # telemetry instants fire at trace time — like the ZeRO overlap
+        # gauge, an AOT cache hit leaves no events and the measured bubble
+        # honestly reads absent (docs/pipeline.md).
+        for tick in sched.ticks:
+            inject = tick.inject_mb
+            if inject is not None:
+                # Stage 0 takes a fresh microbatch; k -> k+1 shift behind
+                # it. XLA: collective-permute over ICI.
+                state = jnp.concatenate([micro[inject][None], state[:-1]],
+                                        axis=0)
+                state_mask = jnp.concatenate(
+                    [micro_mask[inject][None], state_mask[:-1]], axis=0)
+            else:
+                # Circular shift: stage 0 re-enters the ring at the next
+                # chunk (1f1b wrap) or chews dead state (gpipe drain).
+                state = jnp.concatenate([state[-1:], state[:-1]], axis=0)
+                state_mask = jnp.concatenate(
+                    [state_mask[-1:], state_mask[:-1]], axis=0)
             state = nn.with_logical_constraint(
                 state, ("layers", "batch", "seq", "embed"))
-            (state, state_mask), _ = stages((state, state_mask), None)
-            if t >= p - 1:
-                # Stage P-1 just finished microbatch t - (P-1).
-                outputs.append(state[-1])
+            tick_params = self._tick_params(full, tick.chunks, lc)
+            rngs = {}
+            if not deterministic and self.has_rng("dropout"):
+                rngs["dropout"] = self.make_rng("dropout")
+            (state, state_mask), _ = chunk_mod.apply(
+                {"params": tick_params}, (state, state_mask), None,
+                rngs=rngs)
+            tele.instant("pipeline_tick", tick=tick.index,
+                         idle=tick.idle_stages, stages=p, microbatches=m,
+                         schedule=self.schedule, virtual_stages=v)
+            if tick.emit_mb is not None:
+                outputs.append((tick.emit_mb, state[-1]))
 
-        out = jnp.concatenate(outputs, axis=0)  # (M*mb, S, H), in order
+        outputs.sort(key=lambda kv: kv[0])  # already monotone; belt+braces
+        out = jnp.concatenate([o for _, o in outputs], axis=0)
         return out.reshape(b, s, h)
+
+    def _interleave(self, leaf):
+        """Canonical stage-major layout -> 1F1B visit order. Stage k's row
+        must hold, at chunk slot c, global layer block c*P + k (blocks of
+        layers_per_stage/V layers): a static (V, P, Lc) transpose of the
+        layer dims. V=1 is the identity."""
+        p, v = self.num_stages, self.virtual_stages
+        lc = self.layers_per_stage // v
+        rest = leaf.shape[2:]
+        a = leaf.reshape((v, p, lc) + rest)
+        return jnp.moveaxis(a, 0, 1).reshape((p, v * lc) + rest)
+
+    def _tick_params(self, full, chunks, lc):
+        """Per-stage chunk selection for one tick. ``chunks`` has at most
+        two distinct values with a single boundary at k = t mod P (module
+        docstring), so the gather is one or two static slices on the
+        *unsharded* layer dim plus a per-stage select — the pipeline-sharded
+        stage dim is never sliced, keeping every byte stage-local."""
+        p = self.num_stages
+        c_lo, c_hi = chunks[0], chunks[-1]
+        if c_lo == c_hi:
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.slice_in_dim(
+                    a, c_lo * lc, (c_lo + 1) * lc, axis=1), full)
+        boundary = max(k for k in range(p) if chunks[k] == c_lo)
+        row_is_lo = jnp.arange(p) <= boundary
+
+        def select(a):
+            s_lo = jax.lax.slice_in_dim(a, c_lo * lc, (c_lo + 1) * lc, axis=1)
+            s_hi = jax.lax.slice_in_dim(a, c_hi * lc, (c_hi + 1) * lc, axis=1)
+            m = row_is_lo.reshape((p,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, s_lo, s_hi)
+
+        return jax.tree_util.tree_map(select, full)

@@ -114,10 +114,32 @@ SERVE_PREFIX_WORKLOAD = {
     "steps": 10,
     "warmup": 3,
 }
+# The interleaved-pipeline proxy: bert_tiny_pp4 (4 layers, 2 stages,
+# layers_per_stage=2) under the 1F1B schedule with V=2 virtual chunks on
+# a pipeline=2 CPU sub-mesh — every steady-state 1F1B tick, both
+# activation-shift forms (inject + circular wrap), the per-tick chunk
+# selection, and the canonical->interleaved param re-layout all sit
+# inside its timed step. A retrace in the tick loop, a chunk gather that
+# stopped being a static slice, or an accidental sync between ticks
+# fails tier-1 here instead of waiting for chip time.
+PIPELINE_WORKLOAD = {
+    "model": "bert_tiny_pp4",
+    "seq_len": 16,
+    "vocab_size": 256,
+    "batch": 8,
+    "dtype": "float32",
+    "seed": 0,
+    "steps": 6,
+    "warmup": 2,
+    "pp": 2,
+    "pipeline_schedule": "1f1b",
+    "pipeline_virtual_stages": 2,
+}
 WORKLOADS = {
     "default": WORKLOAD,
     "zero2_overlap": dict(WORKLOAD, steps=6, dp=2,
                           optimizer_sharding="zero2"),
+    "pipeline_1f1b": PIPELINE_WORKLOAD,
     "serve_decode": SERVE_WORKLOAD,
     "serve_prefix_prefill": SERVE_PREFIX_WORKLOAD,
 }
@@ -172,19 +194,32 @@ class ProxyRunner:
         from distributeddeeplearning_tpu.train import loop
 
         w = self.workload
-        # Optional workload keys: ``dp`` widens the CPU mesh (needs
-        # --xla_force_host_platform_device_count >= dp, as tests/conftest.py
-        # and tools/perf_gate.py both force), ``optimizer_sharding`` selects
-        # a ZeRO stage — how the zero2_overlap gate workload exists.
+        spec = model_spec(w["model"])
+        # Optional workload keys: ``dp``/``pp`` widen the CPU mesh (need
+        # --xla_force_host_platform_device_count >= dp*pp, as
+        # tests/conftest.py and tools/perf_gate.py both force),
+        # ``optimizer_sharding`` selects a ZeRO stage (the zero2_overlap
+        # workload), ``pipeline_schedule``/``pipeline_virtual_stages``
+        # pick the pipeline schedule (the pipeline_1f1b workload). Token
+        # models get a synthetic token stream sized by ``seq_len``/
+        # ``vocab_size`` instead of the image pipeline.
+        if spec.input_kind == "tokens":
+            data = DataConfig(
+                synthetic=True, seq_len=w.get("seq_len", 16),
+                vocab_size=w.get("vocab_size", 256))
+        else:
+            data = DataConfig(synthetic=True, image_size=w["image_size"],
+                              num_classes=10)
         self.config = TrainConfig(
             model=w["model"], backend="cpu",
             global_batch_size=w["batch"], dtype=w["dtype"],
             seed=w["seed"], log_every=10**9,
             optimizer_sharding=w.get("optimizer_sharding", "none"),
-            data=DataConfig(synthetic=True, image_size=w["image_size"],
-                            num_classes=10),
-            parallel=ParallelConfig(data=w.get("dp", 1)))
-        spec = model_spec(w["model"])
+            pipeline_schedule=w.get("pipeline_schedule", "gpipe"),
+            pipeline_virtual_stages=w.get("pipeline_virtual_stages", 1),
+            data=data,
+            parallel=ParallelConfig(data=w.get("dp", 1),
+                                    pipeline=w.get("pp", 1)))
         (self.mesh, self.model, batch_shd, self.state, self.train_step,
          _sched, self.rng) = loop.build(self.config, _TOTAL_STEPS)
         self.source = datalib.make_source(self.config, spec.input_kind,

@@ -374,3 +374,22 @@ def overlap_fraction(events) -> float:
         if (e.get("args") or {}).get("overlapped"):
             overlapped += 1
     return overlapped / total if total else 0.0
+
+
+def pipeline_bubble_fraction(events):
+    """Measured pipeline bubble: idle stage-ticks / total stage-ticks over
+    the ``pipeline_tick`` instants the schedule loop emits per executed tick
+    (models/pipeline.py). Like :func:`overlap_fraction`, the instants fire
+    at trace time — a multi-step run traced once contributes one full
+    schedule's worth of ticks (re-traces add whole schedules, leaving the
+    ratio unchanged), and an AOT cache hit that skipped tracing leaves no
+    events at all, reported honestly as ``None`` rather than a fake zero
+    (a zero bubble is a real, excellent measurement)."""
+    idle = total = 0
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "pipeline_tick":
+            continue
+        args = e.get("args") or {}
+        idle += int(args.get("idle", 0))
+        total += int(args.get("stages", 0))
+    return idle / total if total else None

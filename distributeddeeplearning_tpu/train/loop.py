@@ -116,13 +116,17 @@ def build(config: TrainConfig, total_steps: int):
             f"unknown optimizer_sharding {config.optimizer_sharding!r}; "
             f"expected one of 'none', 'zero1', 'zero2', 'zero3'")
     if (config.optimizer_sharding != "none"
-            and uses_gspmd(config, spec.input_kind)):
+            and uses_gspmd(config, spec.input_kind)
+            and not (config.optimizer_sharding == "zero2"
+                     and config.parallel.pipeline > 1)):
         raise ValueError(
             f"optimizer_sharding={config.optimizer_sharding!r} applies to "
             "the explicit-DP shard_map path only (image model, no tp/sp "
             "axes — and no fsdp axis except under zero3, which absorbs "
             "it); the GSPMD path shards state via NamedSharding rules "
-            "instead")
+            "instead. Exception: zero2 composes with a pipelined model "
+            "(parallel.pipeline > 1), sharding optimizer state over each "
+            "stage's DP group (docs/pipeline.md)")
     if config.attention_impl == "flash" and config.parallel.seq > 1:
         raise ValueError(
             "attention_impl='flash' is incompatible with seq-axis "
@@ -163,6 +167,10 @@ def build(config: TrainConfig, total_steps: int):
         kw["bn_axis_name"] = steps.DATA_AXES
     if config.pipeline_microbatches:
         kw["pipeline_microbatches"] = config.pipeline_microbatches
+    if config.pipeline_schedule != "gpipe":
+        kw["pipeline_schedule"] = config.pipeline_schedule
+    if config.pipeline_virtual_stages != 1:
+        kw["pipeline_virtual_stages"] = config.pipeline_virtual_stages
     model = spec.build(**kw)
 
     # A mesh axis nothing maps onto silently duplicates compute across its
@@ -183,6 +191,13 @@ def build(config: TrainConfig, total_steps: int):
                 f"pipeline_microbatches set but model {config.model!r} is "
                 f"not pipelined (pipeline_stages={stages}); use a *_pp "
                 f"model")
+    if (config.pipeline_schedule != "gpipe"
+            or config.pipeline_virtual_stages != 1) and stages <= 1:
+        raise ValueError(
+            f"pipeline_schedule={config.pipeline_schedule!r} / "
+            f"pipeline_virtual_stages={config.pipeline_virtual_stages} set "
+            f"but model {config.model!r} is not pipelined "
+            f"(pipeline_stages={stages}); use a *_pp model")
     if config.parallel.pipeline > 1 and stages % config.parallel.pipeline:
         raise ValueError(
             f"parallel.pipeline={config.parallel.pipeline} but model "
@@ -201,11 +216,14 @@ def build(config: TrainConfig, total_steps: int):
     sharded = stage in ("zero1", "zero2", "zero3")
     # Under any ZeRO stage the optimizer sees 1/N chunks, so its norm-based
     # pieces (global clip, LARS/LAMB trust ratios) must psum over the DP
-    # axes.
+    # axes — on the explicit shard_map path only. The GSPMD zero2+pipeline
+    # composition is one logical program with no manual axes to psum over;
+    # XLA inserts any cross-shard reduction the update math needs.
+    explicit_sharded = sharded and not uses_gspmd(config, spec.input_kind)
     tx, sched = optim.make_optimizer(
         config.optimizer, config.global_batch_size, total_steps,
         steps_per_epoch(config),
-        shard_axes=steps.DATA_AXES if sharded else None)
+        shard_axes=steps.DATA_AXES if explicit_sharded else None)
     bn_batch = config.per_device_batch // max(config.grad_accum_steps, 1)
     if config.sync_bn:
         # SyncBN pools statistics across the DP shards: the effective
@@ -245,9 +263,16 @@ def build(config: TrainConfig, total_steps: int):
             objective=spec.objective).batch(0)
         state, shardings = steps.init_sharded_state(
             model, tx, mesh, config, example, rng, spec.input_kind)
+        # Same AOT executable cache as the explicit-DP path below: a warm
+        # boot of an identical config (pipelined runs included — the
+        # schedule is part of the fingerprint) deserializes the step with
+        # zero retraces instead of re-tracing the whole tick loop.
+        aot = aotlib.StepExecutableCache.for_config(
+            config, total_steps=total_steps)
         train_step = steps.make_gspmd_train_step(
             model, tx, mesh, config, shardings, spec.input_kind,
-            spec.objective)
+            spec.objective, aot=aot)
+        train_step.aot = aot
     else:
         def variables_fn(rng):
             if spec.input_kind == "tokens":
@@ -666,6 +691,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     time_to_first_step_s: Optional[float] = None
     compile_pending: Optional[float] = None
     overlap_frac: Optional[float] = None
+    pipeline_bubble: Optional[float] = None
     reconfig_time_s: Optional[float] = None
     try:
         i = start_step  # steps completed so far
@@ -750,6 +776,19 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                         tele.snapshot())
                     tele.gauge("backward_collective_overlap",
                                round(overlap_frac, 4), step=int(i))
+                if tele.enabled and config.parallel.pipeline > 1:
+                    # Measured pipeline bubble: idle/total stage-ticks from
+                    # the per-tick `pipeline_tick` instants the schedule
+                    # emits at trace time. Like the overlap gauge these are
+                    # trace-time events, so an AOT cache hit leaves none —
+                    # the helper returns None then (not a fake 0.0) and the
+                    # gauge is simply skipped. docs/pipeline.md has the
+                    # analytic curve this is compared against in bench.
+                    pipeline_bubble = telemetry.pipeline_bubble_fraction(
+                        tele.snapshot())
+                    if pipeline_bubble is not None:
+                        tele.gauge("pipeline_bubble_fraction",
+                                   round(pipeline_bubble, 4), step=int(i))
             profile.after_step(i - 1, metrics)
             bad_tracker.push(metrics)
             done = i - start_step
@@ -802,6 +841,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     _observe_and_detect(log_rec, int(i), mreg, detector,
                                         flight, tele, bad_tracker,
                                         overlap_frac=overlap_frac,
+                                        pipeline_bubble=pipeline_bubble,
                                         data_wait_s=data_wait_acc,
                                         interval_s=t_log - t_last_log)
                 if heartbeat is not None:
@@ -886,7 +926,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             "overlap": bool(getattr(train_step, "overlap", False)),
             "overlap_fraction": overlap_frac,
         }
-    _write_sharding_sidecar(config, train_step, overlap_frac)
+    if config.parallel.pipeline > 1:
+        summary["pipeline"] = {
+            "schedule": config.pipeline_schedule,
+            "virtual_stages": config.pipeline_virtual_stages,
+            "bubble_fraction": pipeline_bubble,
+        }
+    _write_sharding_sidecar(config, train_step, overlap_frac,
+                            pipeline_bubble)
     aot = getattr(train_step, "aot", None)
     if aot is not None and aot.enabled:
         summary["compile_cache"] = aot.stats()
@@ -1019,8 +1066,8 @@ class _BadStepTracker:
 
 
 def _observe_and_detect(log_rec, step, mreg, detector, flight, tele,
-                        bad_tracker, *, overlap_frac, data_wait_s,
-                        interval_s) -> None:
+                        bad_tracker, *, overlap_frac, pipeline_bubble=None,
+                        data_wait_s, interval_s) -> None:
     """Chief-side log-cadence fan-out: feed the metrics registry and the
     anomaly detector from the record ``MetricLogger.log`` just built.
 
@@ -1033,6 +1080,8 @@ def _observe_and_detect(log_rec, step, mreg, detector, flight, tele,
     mreg.observe_many(log_rec, step=step)
     if overlap_frac is not None:
         mreg.observe("backward_collective_overlap", overlap_frac, step=step)
+    if pipeline_bubble is not None:
+        mreg.observe("pipeline_bubble_fraction", pipeline_bubble, step=step)
     skew = None
     if log_rec.get("host_step_time_mean"):
         skew = (log_rec.get("host_step_time_max", 0.0)
@@ -1074,12 +1123,13 @@ def _sharding_sidecar_path() -> str:
     return sidecars.path_for("last_run_sharding")
 
 
-def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
+def _write_sharding_sidecar(config, train_step, overlap_frac,
+                            pipeline_bubble=None) -> None:
     """Record the run's active sharding stage + overlap status where
     tools/doctor.py looks (best-effort, like the compile-cache stats)."""
     if jax.process_index() != 0:
         return
-    sidecars.write(_sharding_sidecar_path(), {
+    rec = {
         "optimizer_sharding": config.optimizer_sharding,
         "overlap_collectives": bool(
             getattr(config, "overlap_collectives", True)),
@@ -1089,7 +1139,18 @@ def _write_sharding_sidecar(config, train_step, overlap_frac) -> None:
             getattr(config, "opt_state_offload", False)),
         "dp": config.parallel.data * config.parallel.fsdp,
         "model": config.model,
-    })
+    }
+    if config.parallel.pipeline > 1:
+        # Pipeline block for tools/doctor.py check_pipeline: what schedule
+        # the run used and the bubble it measured (None on AOT warm boots
+        # where no trace-time tick instants existed to measure from).
+        rec["pipeline"] = {
+            "stages": config.parallel.pipeline,
+            "schedule": config.pipeline_schedule,
+            "virtual_stages": config.pipeline_virtual_stages,
+            "bubble_fraction": pipeline_bubble,
+        }
+    sidecars.write(_sharding_sidecar_path(), rec)
 
 
 def _elastic_sidecar_path() -> str:

@@ -636,6 +636,39 @@ def _batch_leaf_shardings(mesh: Mesh, batch_shd, batch):
         lambda x: batch_shd if getattr(x, "ndim", 0) >= 1 else rep, batch)
 
 
+def _zero2_opt_state_shardings(mesh: Mesh, abstract_opt, shardings_opt):
+    """ZeRO-2 composition for the GSPMD *pipelined* path: re-spec each
+    optimizer-state leaf to also shard over the DP axes on its first free
+    (unsharded, divisible) dimension. Stage/tp dims keep their axes, so a
+    moment chunk lives inside its stage's DP group — XLA then lowers the
+    gradient reduction feeding the update into a reduce-scatter per group
+    and all-gathers the applied updates, the per-bucket dataflow the
+    explicit shard_map path builds by hand in parallel/zero.py
+    (docs/pipeline.md "Composing with ZeRO-2"). Leaves with no divisible
+    free dim (scalars, odd shapes) stay on their param spec — partial
+    sharding, same rule as the explicit layout planner."""
+    dp_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if not dp_axes:
+        return shardings_opt
+    dp = 1
+    for a in dp_axes:
+        dp *= mesh.shape[a]
+
+    def shard_leaf(aval, shd):
+        shape = getattr(aval, "shape", ())
+        if not shape or not isinstance(shd, NamedSharding):
+            return shd
+        spec = list(shd.spec) + [None] * (len(shape) - len(shd.spec))
+        for d, size in enumerate(shape):
+            if spec[d] is None and size and size % dp == 0:
+                spec[d] = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+                return NamedSharding(mesh, P(*spec))
+        return shd
+
+    return jax.tree_util.tree_map(
+        shard_leaf, nn.meta.unbox(abstract_opt), shardings_opt)
+
+
 def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
                        example_batch: Any, rng: jax.Array,
                        input_kind: str = "tokens"):
@@ -667,6 +700,11 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
     shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P))
+    if (config.optimizer_sharding == "zero2"
+            and getattr(getattr(model, "cfg", None), "pipeline_stages", 1)
+            > 1):
+        shardings = shardings.replace(opt_state=_zero2_opt_state_shardings(
+            mesh, abstract.opt_state, shardings.opt_state))
     with use_mesh(mesh):
         state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings
@@ -674,7 +712,7 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
 
 def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
                           state_shardings, input_kind: str = "tokens",
-                          objective: str = "mlm"):
+                          objective: str = "mlm", aot=None):
     loss_fn = loss_fn_for(model, input_kind, config, objective)
     nan_steps, bad_guard = _guard_config(config)
     # Token batches are (B, S): dim 0 over the DP axes, dim 1 over `seq`.
@@ -721,15 +759,24 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
 
     def compiled(state, batch, rng):
         # One jit wrapper per batch structure — recreating the wrapper per
-        # call would discard the compilation cache.
+        # call would discard the compilation cache. With an AOT cache the
+        # wrapper resolves once to an executable (same contract as the dp
+        # path): fingerprint hit deserializes it — zero retraces, so a
+        # pipelined warm boot skips the whole schedule trace — and a miss
+        # lower().compile()s and saves it for the next attempt.
         key = jax.tree_util.tree_structure(batch)
         if key not in jit_cache:
-            jit_cache[key] = jax.jit(
+            jitted = jax.jit(
                 step_fn,
                 in_shardings=(state_shardings, batch_shardings(batch),
                               NamedSharding(mesh, P())),
                 out_shardings=(state_shardings, NamedSharding(mesh, P())),
                 donate_argnums=0)
+            if aot is not None and aot.enabled:
+                with use_mesh(mesh):
+                    jitted = _aot_acquire(aot, "gspmd_train_step", jitted,
+                                          (state, batch, rng))
+            jit_cache[key] = jitted
         with use_mesh(mesh):
             return jit_cache[key](state, batch, rng)
 

@@ -65,6 +65,9 @@ def pytest_runtest_logreport(report):
         # chaos likewise: --expect-serve-chaos verifies a serve+chaos soak
         # (replica killed mid-stream, token-identical recovery) survived.
         "chaos": "chaos" in report.keywords,
+        # pipeline likewise: --expect-pipeline verifies the schedule
+        # parity pins and the pipeline_1f1b perf-gate workload survived.
+        "pipeline": "pipeline" in report.keywords,
     })
 
 

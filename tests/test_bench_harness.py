@@ -317,6 +317,7 @@ def test_suite_order_contract_for_chip_window(bench):
         "resnet50", "ar_fused", "ar_perleaf", "zero1", "zero2", "zero3",
         "bert512_flash", "gpt2_1024", "bert512", "resnet152",
         "densenet121", "vit_b16", "bert2048_flash",
+        "pp_gpipe", "pp_1f1b",
     ]
     key = {n: (m, o.get("attention_impl"), o.get("seq_len"),
                o.get("allreduce_bucket_mb"))
@@ -334,6 +335,15 @@ def test_suite_order_contract_for_chip_window(bench):
         assert zrow["optimizer_sharding"] == stage
     assert key["bert512_flash"] == ("bert_base", "flash", 512, None)
     assert key["bert2048_flash"] == ("bert_base", "flash", 2048, None)
+    # The pipeline A/B rows pair with each other: identical geometry, the
+    # schedule is the only delta (chip_window.sh's pipeline_ab step
+    # selects both by name).
+    for name, sched, v in (("pp_gpipe", "gpipe", 1), ("pp_1f1b", "1f1b", 2)):
+        row = next(o for n, _m, o, _e in bench.SUITE if n == name)
+        assert key[name] == ("bert_tiny_pp4", None, 128, None)
+        assert row["pp"] == 2
+        assert row["pipeline_schedule"] == sched
+        assert row["pipeline_virtual_stages"] == v
 
 
 def test_suite_rows_validation(bench, capsys):

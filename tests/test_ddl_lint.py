@@ -533,6 +533,121 @@ def test_lint_cow_before_write():
     assert "cow-before-write" in _rules(findings), findings
 
 
+# ---------------------------------------------------------------------------
+# Seeded corpus: pipeline-schedule-pairing (MPMD permute deadlock class)
+# ---------------------------------------------------------------------------
+
+def _pipe_table(name="1f1b", p=2, m=4, v=2):
+    from distributeddeeplearning_tpu.models import pipeline as plib
+    return plib.build_schedule(name, num_stages=p, num_microbatches=m,
+                               virtual_stages=v)
+
+
+def test_pipeline_pairing_clean_corpus():
+    """Every schedule geometry the repo ships — registry pp models' (P, M)
+    under gpipe plus the interleaved variants — verifies pairing-clean.
+    A finding here is a real deadlock in the shipped schedule table."""
+    for name, p, m, v in (("gpipe", 2, 4, 1), ("gpipe", 4, 8, 1),
+                          ("gpipe", 2, 6, 1), ("1f1b", 2, 4, 1),
+                          ("1f1b", 2, 4, 2), ("1f1b", 4, 8, 2),
+                          ("1f1b", 2, 8, 4)):
+        table = _pipe_table(name, p, m, v)
+        assert ca.verify_pipeline_pairing(f"{name}_p{p}m{m}v{v}",
+                                          table) == []
+
+
+def test_pipeline_pairing_fires_on_wrap_inject_collision():
+    """Seeded violation: an inject flag forced onto a wrap-receive tick.
+    Stage 0's program would take the ring wrap and a fresh microbatch in
+    the same shift — the colliding-writes half of the deadlock class —
+    and the conservation check sees a phantom injection."""
+    import dataclasses
+
+    table = _pipe_table()
+    ticks = list(table.ticks)
+    for i, tk in enumerate(ticks):
+        if tk.occupancy[0] is not None and tk.occupancy[0][1] > 0:
+            ticks[i] = dataclasses.replace(tk, inject_mb=99)
+            break
+    bad = dataclasses.replace(table, ticks=tuple(ticks))
+    findings = ca.verify_pipeline_pairing("seeded", bad)
+    assert findings and set(_rules(findings)) == {
+        "pipeline-schedule-pairing"}
+    assert any("waits on a send" in f["message"] for f in findings)
+
+
+def test_pipeline_pairing_fires_on_divergent_stage_view():
+    """Seeded violation: one tick's occupancy permuted across stages — as
+    if stage programs were generated from different tables. The dataflow
+    check names the tick where the per-stage schedules disagree."""
+    import dataclasses
+
+    table = _pipe_table()
+    ticks = list(table.ticks)
+    tk = ticks[3]
+    ticks[3] = dataclasses.replace(tk, occupancy=tuple(
+        reversed(tk.occupancy)))
+    bad = dataclasses.replace(table, ticks=tuple(ticks))
+    findings = ca.verify_pipeline_pairing("seeded", bad)
+    assert any("per-stage schedules disagree" in f["message"]
+               for f in findings), findings
+    assert set(_rules(findings)) == {"pipeline-schedule-pairing"}
+
+
+def test_permute_schedule_fingerprints_differ_by_geometry():
+    """The rendered permute schedule is a function of (schedule, P, M, V):
+    gpipe (no wrap traffic) and 1f1b at the same geometry must not
+    collide, nor must different V."""
+    fps = {(n, p, m, v): ca.permute_schedule(
+               _pipe_table(n, p, m, v)).fingerprint()
+           for n, p, m, v in (("gpipe", 2, 4, 1), ("1f1b", 2, 4, 2),
+                              ("1f1b", 2, 8, 2))}
+    assert len(set(fps.values())) == len(fps)
+    ops = ca.permute_schedule(_pipe_table("1f1b", 2, 4, 2)).ops
+    assert all(op.kind == "ppermute" and op.axes == ("pipeline",)
+               for op in ops)
+
+
+def test_hlo_source_target_pairs_extracted():
+    """collective-permute pairs come out of an HLO dump and participate
+    in the fingerprint — two stage programs lowered with different pair
+    lists must diverge."""
+    a = ('  %cp = f32[8]{0} collective-permute(f32[8]{0} %x), '
+         'source_target_pairs={{0,1},{1,0}}\n')
+    b = ('  %cp = f32[8]{0} collective-permute(f32[8]{0} %x), '
+         'source_target_pairs={{0,1}}\n')
+    sa, sb = ca.extract_from_hlo_text(a), ca.extract_from_hlo_text(b)
+    assert sa.ops[0].kind == "collective-permute"
+    assert sa.ops[0].pairs == ((0, 1), (1, 0))
+    assert sb.ops[0].pairs == ((0, 1),)
+    assert sa.fingerprint() != sb.fingerprint()
+    findings = ca.verify_uniform({"stage0": sa, "stage1": sb})
+    assert _rules(findings) == ["schedule-divergence"]
+
+
+def test_jaxpr_ppermute_pairs_extracted(devices8):
+    """jaxpr extraction captures the `perm` pairs of a ppermute — the
+    shift pattern the pipeline's activation ring compiles down to."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributeddeeplearning_tpu import compat
+    from distributeddeeplearning_tpu.config import ParallelConfig
+    from distributeddeeplearning_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(ParallelConfig(data=8), backend="cpu")
+    perm = [(k, (k + 1) % 8) for k in range(8)]
+
+    def f(x):
+        return jax.lax.ppermute(x, "data", perm)
+
+    fn = compat.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+    sched = ca.schedule_of(fn, jnp.ones((8, 2)))
+    assert [op.kind for op in sched.ops] == ["ppermute"], sched.describe()
+    assert sched.ops[0].pairs == tuple(perm)
+
+
 def test_lint_cow_recorded_clean():
     """engine.py's actual shape: the serve_cow_copy record precedes the
     copy dispatch."""

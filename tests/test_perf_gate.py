@@ -265,6 +265,63 @@ def test_perf_gate_live_zero2_overlap(runner_zero2, monkeypatch, tmp_path):
     assert not (tmp_path / "last.json").exists()
 
 
+# --- the pipeline_1f1b extras workload --------------------------------------
+
+@pytest.fixture(scope="module")
+def runner_pipeline():
+    """ONE compiled pipeline_1f1b proxy (bert_tiny_pp4 on a pipeline=2
+    CPU sub-mesh, 1f1b schedule, V=2) shared by the pipeline gate
+    tests."""
+    return perf_gate.ProxyRunner(perf_gate.WORKLOADS["pipeline_1f1b"])
+
+
+@pytest.mark.perf_gate
+@pytest.mark.pipeline
+def test_perf_gate_live_pipeline_1f1b(runner_pipeline, monkeypatch,
+                                      tmp_path):
+    """The interleaved-schedule gate: the steady-state 1F1B step (tick
+    loop, both shift forms, per-tick chunk selection, canonical->
+    interleaved param re-layout) must sit inside its extras baseline
+    band — a retrace in the tick loop or a chunk gather that stopped
+    being a static slice fails tier-1 here instead of waiting for chip
+    time. Recalibrate with
+    `python tools/perf_gate.py --recalibrate --workload pipeline_1f1b`."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner_pipeline,
+                             workload="pipeline_1f1b")
+    assert result["ok"], "\n".join(result["violations"])
+    assert result["workload_name"] == "pipeline_1f1b"
+    assert result["current"]["workload"]["pipeline_schedule"] == "1f1b"
+    # An extras-workload check never overwrites the headline sidecar.
+    assert not (tmp_path / "last.json").exists()
+
+
+@pytest.mark.perf_gate
+@pytest.mark.pipeline
+def test_pipeline_gate_flips_on_injected_stall(runner_pipeline):
+    """The armed-gate self-test for the pipeline workload: a deliberate
+    stall inside the traced data_wait phase must trip step time out of
+    band AND the data_wait phase share."""
+    baseline = perf_gate.load_baseline(name="pipeline_1f1b")
+    slow = runner_pipeline.measure(inject_sleep_s=0.25)
+    violations = perf_gate.compare(baseline, slow)
+    assert any("step-time regression" in v for v in violations), violations
+    assert any("phase-mix regression" in v and "data_wait" in v
+               for v in violations), violations
+
+
+def test_pipeline_workload_is_registered():
+    """Losing the WORKLOADS entry (or its extras baseline) silently
+    removes the pipeline gate from tools/perf_gate.py."""
+    w = perf_gate.WORKLOADS["pipeline_1f1b"]
+    assert w["pipeline_schedule"] == "1f1b"
+    assert w["pipeline_virtual_stages"] > 1  # V=1 would gate plain gpipe
+    assert w["pp"] > 1
+    assert w["batch"] % perf_gate.WORKLOADS["pipeline_1f1b"]["pp"] == 0
+    assert perf_gate.load_baseline(name="pipeline_1f1b") is not None
+
+
 # --- the serve_prefix_prefill extras workload -------------------------------
 
 @pytest.fixture(scope="module")

@@ -197,6 +197,23 @@ timeout 700 python bench.py --suite --budget 660 \
   > "$RES/bench_zero_ladder.json" 2>> "$RES/log.txt"
 note zero_ladder
 
+# 6d. Pipeline-schedule A/B (gated, ask with DDL_PIPELINE=1): gpipe vs
+# interleaved 1f1b suite rows at IDENTICAL geometry (pp=2, M=4, V=2 — the
+# only delta is the schedule). Each record carries the measured
+# pipeline_bubble_fraction from the trace-time tick instants next to the
+# analytic (P-1)/(M*V+P-1); the acceptance pair (1f1b strictly below
+# gpipe, within 1.5x analytic) lands in bench_pipeline_ab.json
+# (docs/pipeline.md). Gated because the *_pp model variants are not
+# acceptance rows and both arms compile fresh programs (no warm cache
+# from step 1). ~2 x 90 s + compile.
+if [ "${DDL_PIPELINE:-0}" = "1" ]; then
+  check_stop pipeline_ab
+  timeout 480 python bench.py --suite --budget 440 \
+    --suite-rows pp_gpipe,pp_1f1b \
+    > "$RES/bench_pipeline_ab.json" 2>> "$RES/log.txt"
+  note pipeline_ab
+fi
+
 check_stop real_data
 # 7. Remaining real-data legs: native C++ loader + grain only (tf was
 # step 4; re-running it would spend window time on duplicates). 5 legs

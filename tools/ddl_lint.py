@@ -16,8 +16,13 @@ Three passes (distributeddeeplearning_tpu/analysis/):
   order against the planner's promise, planner insertion-order
   determinism, and the (config fingerprint -> schedule fingerprint)
   pairing registry the AOT cache's "equal keys => equal programs"
-  contract needs. ``--hlo`` instead compares schedules extracted from
-  lowered-HLO dumps (e.g. from a chip window).
+  contract needs. Also checks the pipeline schedule tables
+  (``models/pipeline.build_schedule``, gpipe + interleaved 1f1b) with
+  the ``pipeline-schedule-pairing`` rule: every stage's occupancy must
+  be fed by a matching collective-permute edge — the MPMD
+  divergent-schedule deadlock class. ``--hlo`` instead compares
+  schedules extracted from lowered-HLO dumps (e.g. from a chip window),
+  including collective-permute ``source_target_pairs``.
 - ``donation`` — AST taint: restored/orbax-aliased values must pass
   ``checkpoint.device_copy`` before reaching a donated step argument
   (the PR 5 / PR 9 invariant).
@@ -154,6 +159,19 @@ def run_collectives_pass(*, registry_path=None, record: bool = True):
         findings.extend(ca.plan_is_deterministic(
             _grad_tree, pc.plan_buckets,
             bucket_bytes=_TRACE_BUCKET_BYTES))
+        # Pipeline permute pairing: the schedule tables whose shift pairs
+        # become per-stage collective-permute programs (models/pipeline)
+        # must be deadlock-free at the geometries the repo ships — the
+        # registry pp models' (P, M) plus the V>1 interleaved variants.
+        from distributeddeeplearning_tpu.models import pipeline as plib
+        for sname, pp, mm, vv in (("gpipe", 2, 4, 1), ("gpipe", 4, 8, 1),
+                                  ("1f1b", 2, 4, 2), ("1f1b", 4, 8, 2)):
+            label = f"pipeline_{sname}_p{pp}m{mm}v{vv}"
+            table = plib.build_schedule(sname, num_stages=pp,
+                                        num_microbatches=mm,
+                                        virtual_stages=vv)
+            findings.extend(ca.verify_pipeline_pairing(label, table))
+            schedules[label] = ca.permute_schedule(table).fingerprint()
     except Exception as exc:  # noqa: BLE001 — tolerant analyzer
         findings.append(finding(
             "collectives", "analyzer-degraded",

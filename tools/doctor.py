@@ -244,6 +244,28 @@ def check_sharding() -> None:
              note="no sharding sidecar; written by the first train run")
 
 
+def check_pipeline() -> None:
+    """Pipeline-schedule state of the LAST run (the same
+    .cache/last_run_sharding.json sidecar carries a ``pipeline`` block
+    for pipelined configs): stage count, schedule (gpipe / 1f1b),
+    virtual stages, and the measured bubble fraction — null on an AOT
+    warm boot, where nothing re-traced so nothing was observed (see
+    docs/pipeline.md). ok=True always: no block just means the last run
+    was not pipelined."""
+    from distributeddeeplearning_tpu.observability import sidecars
+    side = sidecars.read("last_run_sharding")
+    pipe = side.get("pipeline") if isinstance(side, dict) else None
+    if isinstance(pipe, dict):
+        emit("pipeline", ok=True,
+             **{k: pipe.get(k) for k in (
+                 "stages", "schedule", "virtual_stages",
+                 "bubble_fraction")})
+    else:
+        emit("pipeline", ok=True, last_run=None,
+             note="no pipeline block in the sharding sidecar; written by "
+                  "the first pipelined (--pp > 1) train run")
+
+
 def check_elastic() -> None:
     """Last elastic re-formation (loop.py drops
     .cache/last_elastic_event.json on process 0 when a run resumes under a
@@ -400,6 +422,7 @@ def main(argv=None) -> int:
     check_caches(prune_days=args.prune)
     check_perf_gate()
     check_sharding()
+    check_pipeline()
     check_elastic()
     check_flight()
     check_ddl_lint()

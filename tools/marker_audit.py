@@ -248,6 +248,39 @@ def audit_serve_chaos(records) -> list[str]:
     return problems
 
 
+def audit_pipeline(records) -> list[str]:
+    """Problems with pipeline-schedule coverage in this run.
+
+    The pipeline parity pins (tests marked ``pipeline``: 1f1b-vs-gpipe
+    final-params identity, ZeRO-2 composition, cross-schedule resume)
+    have the same silent-disarm failure modes: the marked tests vanish
+    from the selection, or every one is also marked ``slow`` and tier-1's
+    ``-m 'not slow'`` stops pinning schedule equivalence. The
+    pipeline_1f1b perf-gate workload (tests/test_perf_gate.py) must also
+    have run — losing it quietly un-gates the interleaved tick loop's
+    step cost."""
+    problems = []
+    pipe = [r for r in records if r.get("pipeline")]
+    if not pipe:
+        problems.append(
+            "no pipeline-marked test ran — the pipeline schedules are "
+            "untested in this run (tests/test_pipeline.py missing, "
+            "renamed, or deselected?)")
+    elif all(r.get("slow") for r in pipe):
+        problems.append(
+            "every pipeline-marked test is also marked slow — tier-1 runs "
+            "-m 'not slow', so schedule equivalence is silently unpinned "
+            "in tier-1 (keep a fast pipeline variant unmarked)")
+    if not any(r.get("perf_gate") and "pipeline" in (r.get("nodeid") or "")
+               for r in records):
+        problems.append(
+            "no perf_gate test covering the pipeline_1f1b workload ran — "
+            "the interleaved schedule's step cost is ungated "
+            "(tests/test_perf_gate.py::test_perf_gate_live_pipeline_1f1b "
+            "missing, renamed, or deselected?)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -255,7 +288,8 @@ def main(argv=None) -> int:
         print(f"usage: marker_audit.py <durations.json> [threshold_s="
               f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
               f"[--expect-elastic] [--expect-flight] [--expect-lint] "
-              f"[--expect-serve] [--expect-serve-chaos]")
+              f"[--expect-serve] [--expect-serve-chaos] "
+              f"[--expect-pipeline]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
     expect_elastic = "--expect-elastic" in argv
@@ -263,10 +297,12 @@ def main(argv=None) -> int:
     expect_lint = "--expect-lint" in argv
     expect_serve = "--expect-serve" in argv
     expect_serve_chaos = "--expect-serve-chaos" in argv
+    expect_pipeline = "--expect-pipeline" in argv
     argv = [a for a in argv
             if a not in ("--expect-perf-gate", "--expect-elastic",
                          "--expect-flight", "--expect-lint",
-                         "--expect-serve", "--expect-serve-chaos")]
+                         "--expect-serve", "--expect-serve-chaos",
+                         "--expect-pipeline")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -301,6 +337,10 @@ def main(argv=None) -> int:
     # combo-marked token-identical-recovery test).
     if expect_serve_chaos:
         gate_problems += audit_serve_chaos(records)
+    # Pipeline-schedule coverage likewise (parity pins + the
+    # pipeline_1f1b gate workload).
+    if expect_pipeline:
+        gate_problems += audit_pipeline(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

@@ -125,9 +125,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "data axis, torch SyncBatchNorm semantics; pure-DP "
                         "CNN configs only)")
     p.add_argument("--pp-microbatches", type=int, default=None,
-                   help="GPipe microbatch count for *_pp models; the fill/"
-                        "drain bubble wastes (P-1)/(M+P-1) of each step, so "
-                        "use M >= 4*(P-1)")
+                   help="pipeline microbatch count for *_pp models; the "
+                        "fill/drain bubble wastes (P-1)/(M*V+P-1) of each "
+                        "step, so use M >= 4*(P-1) (or shrink V's "
+                        "denominator with --pipeline-schedule 1f1b)")
+    p.add_argument("--pipeline-schedule", default=None,
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule for *_pp models "
+                        "(models/pipeline.py): gpipe = fill/drain; 1f1b = "
+                        "interleaved one-forward-one-backward over "
+                        "--pipeline-virtual-stages chunks per stage, "
+                        "shrinking the bubble to (P-1)/(M*V+P-1) "
+                        "(docs/pipeline.md)")
+    p.add_argument("--pipeline-virtual-stages", type=int, default=None,
+                   help="virtual chunks per stage for --pipeline-schedule "
+                        "1f1b; must divide layers-per-stage, and M must be "
+                        "a multiple of P when V > 1")
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token models")
     p.add_argument("--mlm-max-predictions", type=int, default=None,
@@ -384,6 +397,18 @@ def build_config(args: argparse.Namespace):
             cfg.optimizer, ema_decay=args.ema_decay))
     if args.pp_microbatches is not None:
         cfg = cfg.replace(pipeline_microbatches=args.pp_microbatches)
+    if args.pipeline_schedule:
+        cfg = cfg.replace(pipeline_schedule=args.pipeline_schedule)
+    if args.pipeline_virtual_stages is not None:
+        if args.pipeline_virtual_stages < 1:
+            raise SystemExit(
+                f"--pipeline-virtual-stages must be >= 1 "
+                f"(got {args.pipeline_virtual_stages})")
+        cfg = cfg.replace(pipeline_virtual_stages=args.pipeline_virtual_stages)
+    if cfg.pipeline_virtual_stages > 1 and cfg.pipeline_schedule != "1f1b":
+        raise SystemExit(
+            "--pipeline-virtual-stages > 1 requires --pipeline-schedule "
+            "1f1b (gpipe has no virtual chunks)")
 
     data_updates = {}
     if args.synthetic is not None:

@@ -1053,6 +1053,11 @@ def _run_elastic_chaos(args) -> int:
             "degree_after": event.get("degree_after"),
             "reformations": proc.stderr.count("# launcher: elastic event:"),
             "grew_back": grew,
+            # Rendezvous-path observability: the outage's detect -> drain ->
+            # restore -> compile -> first-step split and the membership
+            # epoch the final attempt resumed under (train/loop.py).
+            "phases": summary.get("reconfiguration_phases"),
+            "membership_epoch": event.get("epoch"),
             "final_step": summary.get("final_step"),
             "total_s": round(wall, 1),
             "protocol": (f"cpu bert_tiny b8 seq32 {steps} steps, 2 hosts x "

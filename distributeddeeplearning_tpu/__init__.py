@@ -18,6 +18,21 @@ build time (see SURVEY.md header); the capability contract is BASELINE.json
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# Sharding-invariant RNG, set once for every entry point (train.py, bench.py,
+# launch.py children, tests). The legacy threefry lowering lets the SPMD
+# partitioner re-derive per-shard bits, so the *same* (seed, step) batch —
+# and the same init draw — comes out different under a different mesh. That
+# silently breaks the elastic contract: a re-formed attempt that shrinks the
+# data axis (launch.py --elastic-geometry) would train on different synthetic
+# batches than the geometry it resumed from, and cross-geometry trajectory
+# parity (tests/test_elastic_resume.py) is off by per-step data noise, not
+# ULPs. Partitionable threefry makes every draw a pure function of
+# (key, position) regardless of layout. Flipping this changes the bit-stream,
+# so it is part of the AOT cache fingerprint (perf/aot.py).
+_jax.config.update("jax_threefry_partitionable", True)
+
 from distributeddeeplearning_tpu.config import (  # noqa: F401
     DataConfig,
     OptimizerConfig,

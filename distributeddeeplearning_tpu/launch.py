@@ -40,8 +40,10 @@ import sys
 import time
 from typing import Callable, Optional, Sequence
 
+from distributeddeeplearning_tpu import hostmesh
 from distributeddeeplearning_tpu.observability import flight as flightlib
 from distributeddeeplearning_tpu.observability import health, telemetry
+from distributeddeeplearning_tpu.observability import metrics as metricslib
 from distributeddeeplearning_tpu.robustness import faults
 
 ENV_COORDINATOR = "DDL_COORDINATOR"
@@ -151,7 +153,8 @@ def spawn(spec: ProcessSpec, command: Sequence[str], *,
 
 
 def attribute_failure(heartbeat_dir: Optional[str], slot: int, *,
-                      hung: bool = False, ever_beat: bool = False) -> str:
+                      hung: bool = False, ever_beat: bool = False,
+                      epoch: Optional[int] = None) -> str:
     """Classify one failed child from the heartbeat evidence.
 
     The hang watchdog and the elastic controller share ONE staleness clock
@@ -171,7 +174,7 @@ def attribute_failure(heartbeat_dir: Optional[str], slot: int, *,
     if hung:
         return "hung"
     if (heartbeat_dir is not None and ever_beat and not os.path.exists(
-            health.heartbeat_path(heartbeat_dir, slot))):
+            health.heartbeat_path(heartbeat_dir, slot, epoch))):
         return "host_lost"
     return "crash"
 
@@ -198,11 +201,28 @@ class ElasticController:
     de-synchronise shared-cause crash storms) and without burning the
     restart budget (which guards against crash loops — a re-formation IS
     the recovery). Pure stdlib, like the rest of the launcher.
+
+    **Rendezvous membership** (this PR): the controller holds a membership
+    ``epoch``, bumped per committed re-formation. A membership change
+    (join/rejoin/drain marker, or a host-loss attribution) raises the
+    reform barrier (``health.request_reform``) instead of tearing surviving
+    children down: each child polls the barrier at its step boundary, saves
+    collectively when every member is alive (``save=True``), and exits
+    ``health.EXIT_DRAIN`` voluntarily. Heartbeats are namespaced per epoch
+    so a previous epoch's frozen files never feed the new epoch's staleness
+    clock. An optional **geometry table** (``--elastic-geometry``) maps
+    live-host counts to full mesh shapes (dp/pp/optimizer-sharding),
+    letting re-formation cross the ZeRO-stage and pipeline axes — the
+    canonical checkpoint layout makes any pair restorable. When the table
+    forces a smaller host count than survived, **topology-aware survivor
+    selection** (hostmesh.select_survivors) keeps the ICI ring contiguous,
+    logging chosen + rejected candidates to flight.
     """
 
     def __init__(self, num_hosts: int, heartbeat_dir: str, *, base_dp: int,
                  min_hosts: int = 1,
-                 tele: Optional[telemetry.Telemetry] = None):
+                 tele: Optional[telemetry.Telemetry] = None,
+                 geometry: Optional[dict[int, dict]] = None):
         if num_hosts < 1:
             raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
         if base_dp % num_hosts:
@@ -215,6 +235,8 @@ class ElasticController:
         self.heartbeat_dir = heartbeat_dir
         self.min_hosts = max(int(min_hosts), 1)
         self.tele = tele
+        self.geometry = dict(geometry or {})  # live hosts -> mesh shape
+        self.epoch = 0                        # membership epoch (0 = first)
         self.live = list(range(num_hosts))   # original host ids, sorted
         self.events: list[dict] = []         # committed re-formations
         self._slots = list(self.live)        # slot -> host id, per attempt
@@ -227,24 +249,54 @@ class ElasticController:
 
     @property
     def degree(self) -> int:
+        geo = self.geometry.get(len(self.live))
+        if geo is not None:
+            return int(geo["dp"])
         return self.devices_per_host * len(self.live)
 
+    @property
+    def has_pending(self) -> bool:
+        """A membership change is planned but not yet committed — the
+        monitor uses this to pick the drain barrier over fail-whole."""
+        return self._pending is not None
+
+    @property
+    def pending_trigger(self) -> Optional[str]:
+        return self._pending["trigger"] if self._pending else None
+
     def command(self, command: Sequence[str]) -> list[str]:
-        """The training command at the current degree (``--dp`` rewritten;
-        global batch untouched — trajectories stay bitwise)."""
-        return _with_flag_value(command, "--dp", str(self.degree))
+        """The training command at the current membership. Default: ``--dp``
+        rewritten to ``devices_per_host x live`` (global batch untouched —
+        trajectories stay bitwise). With a geometry entry for the live host
+        count, the full mesh shape is rewritten: ``--dp``, ``--pp``, and
+        ``--optimizer-sharding`` — cross-axis re-formation routed through
+        the canonical checkpoint layout."""
+        out = _with_flag_value(command, "--dp", str(self.degree))
+        geo = self.geometry.get(len(self.live))
+        if geo is not None:
+            if "pp" in geo:
+                out = _with_flag_value(out, "--pp", str(geo["pp"]))
+            if "sharding" in geo:
+                out = _with_flag_value(out, "--optimizer-sharding",
+                                       str(geo["sharding"]))
+        return out
 
     def child_env(self, base: dict[int, dict[str, str]]) -> dict:
         """Per-slot extra env for the next attempt. Fault plans follow the
         ORIGINAL host identity across re-formations (a plan injected into
-        host 2 stays with host 2 whatever slot it lands on), and every
-        child of a re-formed attempt receives the membership event
+        host 2 stays with host 2 whatever slot it lands on); every child
+        learns its membership epoch (``DDL_ELASTIC_EPOCH`` — heartbeat
+        namespace + barrier filter) and original host id
+        (``DDL_ELASTIC_HOST`` — drain announcements); and every child of a
+        re-formed attempt receives the membership event
         (``DDL_ELASTIC_EVENT``) so the loop can close the
         reconfiguration_time_s span on the shared monotonic clock."""
         self._slots = list(self.live)
         out: dict[int, dict[str, str]] = {}
         for slot, host in enumerate(self._slots):
             env = dict(base.get(host) or {})
+            env[health.ENV_ELASTIC_EPOCH] = str(self.epoch)
+            env[health.ENV_ELASTIC_HOST] = str(host)
             if self._export is not None:
                 env[health.ENV_ELASTIC_EVENT] = json.dumps(self._export)
             out[slot] = env
@@ -256,28 +308,86 @@ class ElasticController:
         """Attribute one failed child; on host loss, shrink the membership
         and plan a re-formation. Returns the attribution string."""
         label = attribute_failure(self.heartbeat_dir, slot, hung=hung,
-                                  ever_beat=ever_beat)
+                                  ever_beat=ever_beat, epoch=self.epoch)
         if label in ("hung", "host_lost"):
             host = (self._slots[slot] if slot < len(self._slots) else None)
             if host is not None and host in self.live:
                 before = self.degree
                 self.live.remove(host)
+                self._shrink_to_feasible()
                 self._plan(label, before)
         return label
 
     def poll_rejoin(self) -> bool:
-        """Consume a rejoin announcement. True when lost hosts returned and
-        a grow re-formation is now planned — the monitor should then stop
-        the job gracefully. A marker with no one missing is consumed and
-        ignored (the cluster is already whole)."""
-        if not health.consume_rejoin(self.heartbeat_dir):
+        """Consume a rejoin/join announcement. True when lost hosts
+        returned and a grow re-formation is now planned — the monitor
+        should then drain the job at the barrier. A marker with no one
+        missing is consumed and ignored (the cluster is already whole)."""
+        kind = health.consume_join(self.heartbeat_dir)
+        if kind is None:
             return False
         if len(self.live) >= self.max_hosts:
             return False
         before = self.degree
         self.live = list(range(self.max_hosts))
-        self._plan("host_rejoin", before)
+        self._plan(kind, before)
         return True
+
+    def poll_membership(self) -> Optional[str]:
+        """Consume every pending membership announcement — join/rejoin
+        markers (grow) and drain markers (planned leave) — and return the
+        trigger of the newly planned re-formation, or None. The monitor
+        calls this each poll; a returned trigger means it should raise the
+        reform barrier."""
+        trigger: Optional[str] = None
+        if self.poll_rejoin():
+            trigger = self._pending["trigger"]
+        for host in health.consume_drains(self.heartbeat_dir):
+            if host not in self.live:
+                continue
+            if len(self.live) <= max(self.min_hosts, 1):
+                print(f"# launcher: drain of host {host} ignored — only "
+                      f"{len(self.live)} host(s) live (min "
+                      f"{self.min_hosts})", file=sys.stderr, flush=True)
+                continue
+            before = self.degree
+            self.live.remove(host)
+            self._shrink_to_feasible()
+            self._plan("host_drain", before)
+            trigger = "host_drain"
+        return trigger
+
+    def _shrink_to_feasible(self) -> None:
+        """With a geometry table, only listed host counts (plus the full
+        pod) have a mesh shape; after a shrink, land on the largest
+        feasible count <= survivors using topology-aware survivor
+        selection (ICI ring contiguity). Without a table every count is
+        feasible (dp-only scaling) and this is a no-op."""
+        if not self.geometry:
+            return
+        feasible = sorted(set(self.geometry) | {self.max_hosts})
+        target = max((f for f in feasible if f <= len(self.live)),
+                     default=None)
+        if target is None or target >= len(self.live):
+            return
+        survivors, rejected = hostmesh.select_survivors(
+            self.live, target, self.max_hosts)
+        contiguous = hostmesh.is_contiguous_arc(survivors, self.max_hosts)
+        flightlib.get().record(
+            "survivor_selection", candidates=list(self.live),
+            chosen=survivors, rejected=rejected,
+            ring_size=self.max_hosts, contiguous=contiguous)
+        print(f"# launcher: topology-aware shrink: hosts {self.live} -> "
+              f"{survivors} (rejected {rejected}; ring "
+              f"{'contiguous' if contiguous else 'BISECTED'})",
+              file=sys.stderr, flush=True)
+        self.live = survivors
+
+    def note_drain_complete(self) -> None:
+        """Stamp the moment the last member exited into the pending event —
+        the detect->drain phase boundary of the reconfiguration breakdown."""
+        if self._pending is not None:
+            self._pending["drain_done_t"] = telemetry.now_s()
 
     def _plan(self, trigger: str, degree_before: int) -> None:
         now = telemetry.now_s()
@@ -289,17 +399,22 @@ class ElasticController:
             self._pending = {"trigger": trigger,
                              "degree_before": degree_before,
                              "degree_after": self.degree,
+                             # save-capable iff no member is dead: a
+                             # collective save would wedge on a lost rank.
+                             "save": trigger not in ("host_lost", "hung"),
                              "detect_t": now}
         else:
             # Several hosts lost in one poll: one re-formation, spanning
             # from the pre-batch degree to the final survivors.
             self._pending["degree_after"] = self.degree
+            if trigger in ("host_lost", "hung"):
+                self._pending["save"] = False
 
     def take_reconfiguration(self) -> Optional[dict]:
         """The planned membership change for the next attempt, or None.
-        Consumes the plan and arms the event export for the re-formed
-        children. Returns None (give up -> generic failure path) when the
-        surviving set is below ``min_hosts``."""
+        Consumes the plan, bumps the membership epoch, and arms the event
+        export for the re-formed children. Returns None (give up -> generic
+        failure path) when the surviving set is below ``min_hosts``."""
         event, self._pending = self._pending, None
         if event is None:
             return None
@@ -309,9 +424,52 @@ class ElasticController:
                   f"giving up", file=sys.stderr, flush=True)
             return None
         event["degree_after"] = self.degree
+        self.epoch += 1
+        event["epoch"] = self.epoch
         self.events.append(dict(event))
         self._export = dict(event)
         return event
+
+
+def _await_drain(procs: Sequence[subprocess.Popen], heartbeat_dir: str,
+                 elastic: "ElasticController", trigger: str, *, save: bool,
+                 deadline_s: float, poll_interval_s: float = 0.2,
+                 grace_s: float = 10.0) -> None:
+    """Raise the reform barrier and wait for every child to exit on its
+    own — the no-teardown half of rendezvous membership. Children poll the
+    barrier at their step boundaries, save collectively when ``save`` (all
+    members alive), and exit ``health.EXIT_DRAIN``. A child wedged past the
+    deadline (e.g. a survivor stuck in a collective with a dead peer that
+    gloo never errors out of) is escalated to the old terminate path."""
+    health.request_reform(heartbeat_dir, epoch=elastic.epoch + 1,
+                          trigger=trigger, save=save)
+    flightlib.get().record("reform_barrier", trigger=trigger,
+                           epoch=elastic.epoch + 1, save=save)
+    deadline = time.monotonic() + deadline_s
+    escalated = False
+    while any(p.poll() is None for p in procs):
+        if time.monotonic() > deadline:
+            late = sum(1 for p in procs if p.poll() is None)
+            print(f"# launcher: drain barrier deadline ({deadline_s:.0f}s) "
+                  f"passed with {late} child(ren) still running — "
+                  f"escalating to terminate", file=sys.stderr, flush=True)
+            flightlib.get().record("drain_escalated", children=late,
+                                   trigger=trigger)
+            _terminate_all(procs, grace_s)
+            escalated = True
+            break
+        time.sleep(poll_interval_s)
+    elastic.note_drain_complete()
+    health.clear_reform(heartbeat_dir)
+    rcs = [p.poll() for p in procs]
+    drained = sum(1 for rc in rcs if rc == health.EXIT_DRAIN)
+    flightlib.get().record("drain_complete", trigger=trigger,
+                           drained=drained, rcs=[int(rc) if rc is not None
+                                                 else None for rc in rcs],
+                           escalated=escalated)
+    print(f"# launcher: drain complete — {drained}/{len(rcs)} child(ren) "
+          f"exited at the barrier (rc={health.EXIT_DRAIN})",
+          file=sys.stderr, flush=True)
 
 
 def monitor(children: Sequence[subprocess.Popen], *,
@@ -319,6 +477,7 @@ def monitor(children: Sequence[subprocess.Popen], *,
             grace_s: float = 10.0,
             heartbeat_dir: Optional[str] = None,
             heartbeat_timeout_s: float = 0.0,
+            heartbeat_epoch: int = 0,
             tele: Optional[telemetry.Telemetry] = None,
             elastic: Optional["ElasticController"] = None) -> int:
     """Wait for all children; kill the survivors as soon as one fails.
@@ -335,9 +494,12 @@ def monitor(children: Sequence[subprocess.Popen], *,
 
     With an ``elastic`` controller, failures are attributed from the
     heartbeat evidence (crash vs host_lost vs hung) and host losses shrink
-    the controller's membership for the next attempt; a rejoin marker in
-    the heartbeat dir stops the job gracefully (SIGTERM → children save at
-    the next step boundary) so the next attempt can grow back.
+    the controller's membership for the next attempt; a join/rejoin/drain
+    marker in the heartbeat dir raises the reform barrier — children save
+    at their next step boundary and exit voluntarily (rendezvous
+    membership: surviving children are never torn down for a planned
+    change). ``heartbeat_epoch`` selects the heartbeat namespace this
+    attempt's children beat into.
     """
     procs = list(children)
     hb_armed = heartbeat_dir is not None and heartbeat_timeout_s > 0
@@ -350,11 +512,13 @@ def monitor(children: Sequence[subprocess.Popen], *,
             if track_beats:
                 for idx in range(len(procs)):
                     if idx not in ever_beat and os.path.exists(
-                            health.heartbeat_path(heartbeat_dir, idx)):
+                            health.heartbeat_path(heartbeat_dir, idx,
+                                                  heartbeat_epoch)):
                         ever_beat.add(idx)
             if hb_armed:
                 for idx, age in health.check_stale(
-                        heartbeat_dir, len(procs), heartbeat_timeout_s):
+                        heartbeat_dir, len(procs), heartbeat_timeout_s,
+                        epoch=heartbeat_epoch):
                     if idx < len(procs) and procs[idx].poll() is None:
                         print(f"# launcher: child {idx} heartbeat stale "
                               f"({age:.1f}s > {heartbeat_timeout_s:.1f}s) — "
@@ -367,20 +531,38 @@ def monitor(children: Sequence[subprocess.Popen], *,
                                                age_s=round(age, 1))
                         hung.add(idx)
                         procs[idx].kill()
-            if elastic is not None and elastic.poll_rejoin():
-                # A lost host came back: stop the job GRACEFULLY (SIGTERM,
-                # generous grace so every child saves at its next step
-                # boundary via the loop's preemption handler) and report
-                # nonzero — run_with_restarts then relaunches at the grown
-                # degree without burning the budget.
-                print("# launcher: host rejoin announced — stopping to "
-                      "re-form at the grown degree",
-                      file=sys.stderr, flush=True)
-                if tele is not None:
-                    tele.instant("launcher:host_rejoin")
-                flightlib.get().record("host_rejoin")
-                _terminate_all(procs, max(grace_s, 30.0))
-                return 1
+            if elastic is not None:
+                trigger = elastic.poll_membership()
+                if trigger is not None:
+                    # A membership change was announced while every member
+                    # is alive: raise the reform barrier instead of tearing
+                    # the job down. Children save collectively at their
+                    # next step boundary and exit EXIT_DRAIN voluntarily —
+                    # run_with_restarts then relaunches at the new
+                    # membership without burning the budget.
+                    if trigger in ("host_rejoin", "host_join"):
+                        print(f"# launcher: host rejoin announced "
+                              f"({trigger}) — draining at the reform "
+                              f"barrier to re-form at the grown degree",
+                              file=sys.stderr, flush=True)
+                    else:
+                        print(f"# launcher: host drain announced — "
+                              f"draining at the reform barrier to re-form "
+                              f"at the shrunk degree",
+                              file=sys.stderr, flush=True)
+                    if tele is not None:
+                        tele.instant("launcher:membership_change",
+                                     trigger=trigger)
+                    if trigger in ("host_rejoin", "host_join"):
+                        flightlib.get().record("host_rejoin",
+                                               trigger=trigger)
+                    else:
+                        flightlib.get().record("host_drain", trigger=trigger)
+                    _await_drain(procs, heartbeat_dir, elastic, trigger,
+                                 save=True, deadline_s=max(grace_s, 30.0),
+                                 poll_interval_s=poll_interval_s,
+                                 grace_s=grace_s)
+                    return 1
             codes = [p.poll() for p in procs]
             failed = [(i, c) for i, c in enumerate(codes)
                       if c not in (None, 0)]
@@ -410,6 +592,27 @@ def monitor(children: Sequence[subprocess.Popen], *,
                     print(f"# launcher: child {idx} exited rc={c}{why}"
                           f"{attributed}", file=sys.stderr, flush=True)
                 survivors = sum(1 for c in codes if c is None)
+                if (elastic is not None and elastic.has_pending
+                        and survivors):
+                    # Host loss with a re-formation planned: survivors
+                    # drain at the reform barrier instead of being torn
+                    # down. save=False — the dead peer makes a collective
+                    # save impossible (a gloo save would wedge on the
+                    # missing rank); survivors exit at their next step
+                    # boundary and the re-formed attempt resumes from the
+                    # last committed checkpoint. A survivor that crashes
+                    # first on its own collective error counts as exited.
+                    print(f"# launcher: membership loss — draining "
+                          f"{survivors} surviving child(ren) at the reform "
+                          f"barrier (no teardown)",
+                          file=sys.stderr, flush=True)
+                    _await_drain(procs, heartbeat_dir, elastic,
+                                 elastic.pending_trigger or "host_lost",
+                                 save=False,
+                                 deadline_s=max(grace_s, 10.0),
+                                 poll_interval_s=poll_interval_s,
+                                 grace_s=grace_s)
+                    return int(failed[0][1]) or 1
                 if survivors:
                     print(f"# launcher: terminating {survivors} surviving "
                           "child(ren) (fail-whole)",
@@ -442,6 +645,7 @@ def run_local(num_processes: int, command: Sequence[str], *,
               child_env: Optional[dict[int, dict[str, str]]] = None,
               heartbeat_dir: Optional[str] = None,
               heartbeat_timeout_s: float = 0.0,
+              heartbeat_epoch: int = 0,
               tele: Optional[telemetry.Telemetry] = None,
               elastic: Optional["ElasticController"] = None) -> int:
     """Spawn + monitor N local processes (the `mpirun -np N` replacement).
@@ -450,7 +654,9 @@ def run_local(num_processes: int, command: Sequence[str], *,
     how ``--child-fault-plan`` targets one rank of a simulated pod.
     With a ``heartbeat_dir``, children are told to beat there
     (``DDL_HEARTBEAT_DIR``; the train loop beats on log cadence) and the
-    monitor watches for staleness.
+    monitor watches for staleness. ``heartbeat_epoch`` names the membership
+    epoch this attempt beats under (elastic rendezvous; 0 = the legacy
+    un-namespaced files).
     """
     specs = plan_local(num_processes, port=port)
     if heartbeat_dir is not None:
@@ -458,7 +664,8 @@ def run_local(num_processes: int, command: Sequence[str], *,
         # (now frozen) heartbeats: each attempt re-arms from nothing.
         for s in specs:
             try:
-                os.remove(health.heartbeat_path(heartbeat_dir, s.process_id))
+                os.remove(health.heartbeat_path(heartbeat_dir, s.process_id,
+                                                heartbeat_epoch))
             except OSError:
                 pass
     children = []
@@ -466,9 +673,11 @@ def run_local(num_processes: int, command: Sequence[str], *,
         extra = dict((child_env or {}).get(s.process_id) or {})
         if heartbeat_dir is not None:
             extra[health.ENV_HEARTBEAT_DIR] = heartbeat_dir
+            extra.setdefault(health.ENV_ELASTIC_EPOCH, str(heartbeat_epoch))
         children.append(spawn(s, command, extra_env=extra))
     return monitor(children, heartbeat_dir=heartbeat_dir,
-                   heartbeat_timeout_s=heartbeat_timeout_s, tele=tele,
+                   heartbeat_timeout_s=heartbeat_timeout_s,
+                   heartbeat_epoch=heartbeat_epoch, tele=tele,
                    elastic=elastic)
 
 
@@ -541,6 +750,7 @@ def run_with_restarts(run_once, max_restarts: int, *,
     window_used = 0    # restarts consumed since the last observed progress
     last_progress = progress_fn() if progress_fn is not None else None
     prev_attempt = os.environ.get(faults.ENV_ATTEMPT)
+    storm_detector = None  # lazy: only elastic jobs pay for it
     try:
         while True:
             os.environ[faults.ENV_ATTEMPT] = str(total)
@@ -579,7 +789,24 @@ def run_with_restarts(run_once, max_restarts: int, *,
                         "reconfiguration_planned",
                         trigger=event["trigger"],
                         degree_before=event["degree_before"],
-                        degree_after=event["degree_after"])
+                        degree_after=event["degree_after"],
+                        epoch=event.get("epoch"))
+                    # Re-formation storm watch: a handful of planned
+                    # re-formations is the feature working; a storm means
+                    # membership is flapping faster than training can
+                    # amortize (observability/anomaly.py discipline).
+                    if storm_detector is None:
+                        from distributeddeeplearning_tpu.observability \
+                            import anomaly as anomalylib
+                        storm_detector = anomalylib.AnomalyDetector()
+                    flagged = storm_detector.update_elastic(
+                        telemetry.now_s(), epoch=event.get("epoch"))
+                    if flagged:
+                        from distributeddeeplearning_tpu.observability \
+                            import anomaly as anomalylib
+                        anomalylib.report(flagged,
+                                          flight_rec=flightlib.get(),
+                                          tele=tele)
                     if progress_fn is not None:
                         # A re-formed attempt starts a fresh progress
                         # window — don't let the pre-shrink baseline
@@ -654,6 +881,12 @@ def _spawn_replica(replica: int, num_replicas: int, workdir: str, *,
     env[ENV_PROCESS_ID] = str(replica)
     env[ENV_NUM_PROCESSES] = str(num_replicas)
     env.pop(ENV_COORDINATOR, None)
+    # Serve replicas are outside the training membership: a stale elastic
+    # epoch/identity inherited from a training launcher would namespace
+    # their heartbeats away from the supervisor's staleness check.
+    env.pop(health.ENV_ELASTIC_EPOCH, None)
+    env.pop(health.ENV_ELASTIC_HOST, None)
+    env.pop(health.ENV_ELASTIC_EVENT, None)
     env[faults.ENV_ATTEMPT] = str(attempt)
     if fault_plan:
         env[faults.ENV_PLAN] = fault_plan
@@ -687,6 +920,64 @@ def _dispatch_request(workdir: str, replica: int, attempt: int,
     os.replace(tmp, os.path.join(inbox, name))
 
 
+class AutoscalePolicy:
+    """Deterministic hysteresis over the supervisor's queue-depth gauge.
+
+    The elastic controller's substrate applied to serving (ROADMAP 1d):
+    instead of mesh re-formation, membership change means spawning or
+    draining independent replicas. The policy is pure — ``decide`` sees
+    only the gauge values the supervisor just observed into
+    ``observability/metrics.py`` and its own streak counters — so unit
+    tests can drive it with synthetic traffic and pin every transition.
+
+    Scale-up: the backlog has exceeded ``up_backlog_per_replica`` open
+    requests per live replica for ``up_sustain_polls`` consecutive polls
+    (a burst shorter than the sustain window is absorbed, not scaled
+    for). Scale-down: the queue has been empty for ``down_idle_polls``
+    consecutive polls. Both directions respect [min_replicas,
+    max_replicas]; a decision resets both streaks so scale events are
+    spaced by at least one full sustain window.
+    """
+
+    def __init__(self, min_replicas: int, max_replicas: int, *,
+                 up_backlog_per_replica: float = 2.0,
+                 up_sustain_polls: int = 3,
+                 down_idle_polls: int = 40):
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas={min_replicas}: need >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError(f"max_replicas={max_replicas} < "
+                             f"min_replicas={min_replicas}")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_backlog_per_replica = float(up_backlog_per_replica)
+        self.up_sustain_polls = int(up_sustain_polls)
+        self.down_idle_polls = int(down_idle_polls)
+        self._up_streak = 0
+        self._idle_streak = 0
+
+    def decide(self, *, queue_depth: int, live_replicas: int) -> int:
+        """+1 (scale up), -1 (scale down), or 0 — given the current open
+        (dispatched or due, unclosed) request count and live replicas."""
+        if queue_depth > self.up_backlog_per_replica * max(1, live_replicas):
+            self._up_streak += 1
+        else:
+            self._up_streak = 0
+        if queue_depth == 0:
+            self._idle_streak += 1
+        else:
+            self._idle_streak = 0
+        if (self._up_streak >= self.up_sustain_polls
+                and live_replicas < self.max_replicas):
+            self._up_streak = self._idle_streak = 0
+            return 1
+        if (self._idle_streak >= self.down_idle_polls
+                and live_replicas > self.min_replicas):
+            self._up_streak = self._idle_streak = 0
+            return -1
+        return 0
+
+
 def run_serve(num_replicas: int, requests: Sequence[dict],
               serve_config: dict, *, workdir: str,
               heartbeat_dir: Optional[str] = None,
@@ -696,6 +987,7 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
               flight_dir: Optional[str] = None,
               poll_interval_s: float = 0.05,
               timeout_s: float = 600.0,
+              autoscale: Optional[AutoscalePolicy] = None,
               clock: Callable[[], float] = time.monotonic) -> dict:
     """Supervise N serve-engine replicas over one request trace.
 
@@ -716,9 +1008,23 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     results plus the incident/restart accounting; the flight record gets
     the full chain (``serve_replica_lost`` -> ``serve_redispatch`` ->
     ``serve_replayed``) for ``tools/postmortem.py``.
+
+    With ``autoscale`` (an :class:`AutoscalePolicy`), the supervisor
+    observes its open-request backlog and shed count into
+    ``observability/metrics.py`` gauges every poll and lets the policy
+    drive the replica count: scale-up spawns a fresh replica that warms
+    from the SHARED serve AOT executable cache (every replica reads the
+    same ``config.json``, so the fingerprint matches and the new replica
+    skips compilation); scale-down routes through the stop-sentinel drain
+    gate, so a scaled-down replica still runs the shutdown leak check.
     """
     if num_replicas < 1:
         raise ValueError(f"num_replicas={num_replicas}: need >= 1")
+    if autoscale is not None:
+        # Start inside the policy's band: the floor is the availability
+        # promise, the ceiling the cost cap.
+        num_replicas = min(max(num_replicas, autoscale.min_replicas),
+                           autoscale.max_replicas)
     os.makedirs(workdir, exist_ok=True)
     if heartbeat_dir is not None:
         os.makedirs(heartbeat_dir, exist_ok=True)
@@ -761,11 +1067,17 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
         reps.append({"proc": proc, "alive": True, "attempt": 0,
                      "restarts": 0, "ever_beat": False, "hung": False,
                      "last_step": 0, "offset": 0, "rc": None,
-                     "drained": False})
+                     "drained": False, "draining": False})
         flight.record("spawn", child=i, pid=proc.pid, scope="serve")
 
     redispatched = 0
     total_restarts = 0
+    scale_ups = 0
+    scale_downs = 0
+    gauges = metricslib.MetricsRegistry(
+        run_id=os.environ.get(flightlib.ENV_RUN_ID, "")) \
+        if autoscale is not None else None
+    poll_n = 0
     stopping = False
     t0 = clock()
 
@@ -866,7 +1178,8 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     try:
         while True:
             now = clock()
-            alive = [i for i, r in enumerate(reps) if r["alive"]]
+            alive = [i for i, r in enumerate(reps)
+                     if r["alive"] and not r["draining"]]
             # Dispatch due requests round-robin over live replicas; a
             # re-dispatched victim carries its received prefix.
             if alive:
@@ -889,11 +1202,70 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                         flight.record("serve_redispatch", request=uid,
                                       to=rid, resumed_from=len(st["tokens"]),
                                       retries=st["retries"])
-            for rid in range(num_replicas):
+            # Autoscaling: observe the gauges, then let the policy move
+            # the replica count (elastic membership for independent
+            # replicas — ROADMAP 1d).
+            if autoscale is not None and not stopping:
+                poll_n += 1
+                backlog = sum(1 for st in reqs.values()
+                              if not closed(st)
+                              and now - t0 >= st["arrival_s"])
+                shed = sum(1 for st in reqs.values()
+                           if st["failed"] == "retries_exhausted")
+                gauges.observe("serve_queue_depth", backlog, step=poll_n)
+                gauges.observe("serve_shed_total", shed, step=poll_n)
+                gauges.observe("serve_live_replicas", len(alive),
+                               step=poll_n)
+                move = autoscale.decide(queue_depth=backlog,
+                                        live_replicas=len(alive)) \
+                    if alive else 0
+                if move > 0:
+                    rid = len(reps)
+                    proc = _spawn_replica(
+                        rid, rid + 1, workdir, attempt=0,
+                        heartbeat_dir=heartbeat_dir,
+                        fault_plan=plans.get(rid))
+                    reps.append({"proc": proc, "alive": True,
+                                 "attempt": 0, "restarts": 0,
+                                 "ever_beat": False, "hung": False,
+                                 "last_step": 0, "offset": 0, "rc": None,
+                                 "drained": False, "draining": False})
+                    scale_ups += 1
+                    flight.record("spawn", child=rid, pid=proc.pid,
+                                  scope="serve")
+                    flight.record("serve_scale_up", replica=rid,
+                                  queue_depth=backlog,
+                                  live=len(alive) + 1, warm=True)
+                    print(f"# launcher: serve autoscale up — replica "
+                          f"{rid} spawned warm (queue depth {backlog} "
+                          f"over {len(alive)} live)",
+                          file=sys.stderr, flush=True)
+                elif move < 0:
+                    # Drain the newest idle replica (no open requests
+                    # assigned) through the stop-sentinel gate.
+                    idle = [i for i in reversed(alive)
+                            if not any(st["replica"] == i
+                                       and st["dispatched"]
+                                       and not closed(st)
+                                       for st in reqs.values())]
+                    if idle:
+                        rid = idle[0]
+                        reps[rid]["draining"] = True
+                        with open(os.path.join(workdir, f"stop.r{rid}"),
+                                  "w", encoding="utf-8") as f:
+                            f.write("drain\n")
+                        scale_downs += 1
+                        flight.record("serve_scale_down", replica=rid,
+                                      live=len(alive) - 1)
+                        print(f"# launcher: serve autoscale down — "
+                              f"replica {rid} draining (idle "
+                              f"{autoscale.down_idle_polls} polls)",
+                              file=sys.stderr, flush=True)
+            for rid in range(len(reps)):
                 if reps[rid]["alive"]:
                     drain_events(rid)
             if heartbeat_dir is not None:
-                for rid in range(num_replicas):
+                for rid in range(len(reps)):
                     rep = reps[rid]
                     if rep["alive"] and not rep["ever_beat"]:
                         rep["ever_beat"] = os.path.exists(
@@ -902,14 +1274,14 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                     beat_set = {i for i, r in enumerate(reps)
                                 if r["alive"] and r["ever_beat"]}
                     for pid, age in health.check_stale(
-                            heartbeat_dir, num_replicas,
+                            heartbeat_dir, len(reps),
                             heartbeat_timeout_s):
                         if pid in beat_set and not reps[pid]["hung"]:
                             reps[pid]["hung"] = True
                             flight.record("heartbeat_stale", child=pid,
                                           age_s=round(age, 3), scope="serve")
                             reps[pid]["proc"].kill()
-            for rid in range(num_replicas):
+            for rid in range(len(reps)):
                 rep = reps[rid]
                 if rep["alive"]:
                     rc = rep["proc"].poll()
@@ -918,7 +1290,7 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
             if all(closed(st) for st in reqs.values()):
                 if not stopping:
                     stopping = True
-                    for rid in range(num_replicas):
+                    for rid in range(len(reps)):
                         with open(os.path.join(workdir, f"stop.r{rid}"),
                                   "w", encoding="utf-8") as f:
                             f.write("drain\n")
@@ -947,7 +1319,8 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     window_s = clock() - t0
     flight.record("serve_drained", window_s=round(window_s, 3),
                   redispatched=redispatched, restarts=total_restarts,
-                  leak_check_ok=leak_check_ok)
+                  leak_check_ok=leak_check_ok, scale_ups=scale_ups,
+                  scale_downs=scale_downs)
     results = {}
     for uid, st in reqs.items():
         ttft = None
@@ -957,10 +1330,18 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                         "finished": st["finished"],
                         "failed": st["failed"],
                         "retries": st["retries"], "ttft_s": ttft}
-    return {"results": results, "redispatched": redispatched,
-            "restarts": total_restarts, "window_s": window_s,
-            "leak_check_ok": leak_check_ok,
-            "replica_rcs": {i: r["rc"] for i, r in enumerate(reps)}}
+    out = {"results": results, "redispatched": redispatched,
+           "restarts": total_restarts, "window_s": window_s,
+           "leak_check_ok": leak_check_ok,
+           "replica_rcs": {i: r["rc"] for i, r in enumerate(reps)}}
+    if autoscale is not None:
+        out["autoscale"] = {"scale_ups": scale_ups,
+                            "scale_downs": scale_downs,
+                            "peak_replicas": len(reps),
+                            "min_replicas": autoscale.min_replicas,
+                            "max_replicas": autoscale.max_replicas,
+                            "gauges": gauges.aggregate()["metrics"]}
+    return out
 
 
 def _main_serve(args, p) -> int:
@@ -988,11 +1369,23 @@ def _main_serve(args, p) -> int:
     heartbeat_dir = args.heartbeat_dir or tempfile.mkdtemp(
         prefix="ddl-serve-hb-")
 
+    autoscale = None
+    if args.serve_autoscale:
+        lo_s, sep, hi_s = args.serve_autoscale.partition(":")
+        if not sep or not lo_s.isdigit() or not hi_s.isdigit():
+            p.error(f"--serve-autoscale expects MIN:MAX, got "
+                    f"{args.serve_autoscale!r}")
+        try:
+            autoscale = AutoscalePolicy(int(lo_s), int(hi_s))
+        except ValueError as e:
+            p.error(f"--serve-autoscale: {e}")
+
     out = run_serve(args.num_processes or 1, requests, serve_config,
                     workdir=workdir, heartbeat_dir=heartbeat_dir,
                     heartbeat_timeout_s=args.heartbeat_timeout,
                     max_restarts=args.max_restarts,
-                    child_fault_plans=plans, flight_dir=args.flight_dir)
+                    child_fault_plans=plans, flight_dir=args.flight_dir,
+                    autoscale=autoscale)
     if args.serve_out:
         with open(args.serve_out, "w", encoding="utf-8") as f:
             json.dump(out, f, indent=2, sort_keys=True, default=str)
@@ -1064,6 +1457,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--min-hosts", type=int, default=1,
                    help="with --elastic, give up (generic failure path) "
                         "instead of re-forming below this many hosts")
+    p.add_argument("--elastic-geometry", action="append", default=[],
+                   metavar="HOSTS:dp=D[,pp=P][,sharding=S]",
+                   help="with --elastic, the full mesh shape to re-form at "
+                        "when HOSTS hosts are live (repeatable), e.g. "
+                        "1:dp=1,pp=4,sharding=none — re-formation then "
+                        "crosses the pipeline-degree and ZeRO-stage axes "
+                        "through the canonical checkpoint layout. dp*pp "
+                        "must equal HOSTS x devices-per-host. Host counts "
+                        "not listed (other than the full pod) shrink to "
+                        "the largest listed count via topology-aware "
+                        "survivor selection (docs/fault_tolerance.md)")
+    p.add_argument("--serve-autoscale", default=None, metavar="MIN:MAX",
+                   help="with --serve, autoscale the replica count between "
+                        "MIN and MAX from the supervisor's queue-depth "
+                        "gauge: sustained backlog per live replica scales "
+                        "up (warm via the shared serve AOT fingerprint), "
+                        "sustained idleness scales down (docs/serving.md)")
     p.add_argument("--flight-dir", default=None,
                    help="flight recorder directory (observability/"
                         "flight.py): the launcher mints one run id for the "
@@ -1113,6 +1523,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.serve_config is None:
             p.error("--serve requires --serve-config")
         return _main_serve(args, p)
+    if args.serve_autoscale:
+        p.error("--serve-autoscale requires --serve")
     if not command:
         p.error("no training command given (pass it after `--`)")
 
@@ -1237,11 +1649,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if base_dp % n:
             p.error(f"--elastic: --dp {base_dp} must divide evenly over "
                     f"--num-processes {n}")
-        # A stale rejoin marker from a previous job must not trigger a
-        # phantom grow on the first failure of this one.
+        geometry = _parse_elastic_geometry(
+            args.elastic_geometry, p, num_hosts=n, base_dp=base_dp,
+            base_pp=_flag_from_command(command, "--pp"))
+        # A stale rejoin/drain marker or reform barrier from a previous job
+        # must not trigger a phantom re-formation on the first failure of
+        # this one.
         health.consume_rejoin(heartbeat_dir)
+        health.consume_drains(heartbeat_dir)
+        health.clear_reform(heartbeat_dir)
         elastic_ctl = ElasticController(n, heartbeat_dir, base_dp=base_dp,
-                                        min_hosts=args.min_hosts, tele=tele)
+                                        min_hosts=args.min_hosts, tele=tele,
+                                        geometry=geometry)
+    elif args.elastic_geometry:
+        p.error("--elastic-geometry requires --elastic")
 
     if elastic_ctl is not None:
         run_once = lambda: run_local(  # noqa: E731
@@ -1249,6 +1670,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             port=args.port, child_env=elastic_ctl.child_env(child_env),
             heartbeat_dir=heartbeat_dir,
             heartbeat_timeout_s=args.heartbeat_timeout,
+            heartbeat_epoch=elastic_ctl.epoch,
             tele=tele, elastic=elastic_ctl)
     else:
         run_once = lambda: run_local(  # noqa: E731
@@ -1274,6 +1696,50 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     flight.record("job_end", rc=rc)
     flight.close()
     return rc
+
+
+def _parse_elastic_geometry(items: Sequence[str], p, *, num_hosts: int,
+                            base_dp: int, base_pp: Optional[str]
+                            ) -> dict[int, dict]:
+    """Parse repeated ``--elastic-geometry HOSTS:dp=D[,pp=P][,sharding=S]``
+    entries into the controller's geometry table, validating each shape
+    against the pod's device budget (dp*pp == hosts x devices-per-host)."""
+    pp = int(base_pp) if base_pp and base_pp.isdigit() else 1
+    if (base_dp * pp) % num_hosts:
+        p.error(f"--elastic-geometry: base mesh dp={base_dp} pp={pp} does "
+                f"not fill {num_hosts} host(s) evenly")
+    devices_per_host = (base_dp * pp) // num_hosts
+    geometry: dict[int, dict] = {}
+    for item in items:
+        hosts_s, sep, spec = item.partition(":")
+        if not sep or not hosts_s.isdigit() or int(hosts_s) < 1:
+            p.error(f"--elastic-geometry expects HOSTS:dp=D[,pp=P]"
+                    f"[,sharding=S], got {item!r}")
+        hosts = int(hosts_s)
+        if hosts > num_hosts:
+            p.error(f"--elastic-geometry {item!r}: {hosts} hosts exceeds "
+                    f"--num-processes {num_hosts}")
+        entry: dict = {}
+        for kv in spec.split(","):
+            key, sep2, value = kv.partition("=")
+            if key == "dp" and sep2 and value.isdigit():
+                entry["dp"] = int(value)
+            elif key == "pp" and sep2 and value.isdigit():
+                entry["pp"] = int(value)
+            elif key == "sharding" and sep2 and value in (
+                    "none", "zero1", "zero2", "zero3"):
+                entry["sharding"] = value
+            else:
+                p.error(f"--elastic-geometry: bad field {kv!r} in {item!r}")
+        if "dp" not in entry:
+            p.error(f"--elastic-geometry {item!r}: dp= is required")
+        shape = entry["dp"] * entry.get("pp", 1)
+        if shape != devices_per_host * hosts:
+            p.error(f"--elastic-geometry {item!r}: dp x pp = {shape} does "
+                    f"not fill {hosts} host(s) x {devices_per_host} "
+                    f"device(s)")
+        geometry[hosts] = entry
+    return geometry
 
 
 def _flag_from_command(command: Sequence[str], flag: str) -> Optional[str]:

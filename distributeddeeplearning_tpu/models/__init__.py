@@ -152,6 +152,24 @@ def _registry() -> dict[str, ModelSpec]:
                 **{"num_layers": 4, "pipeline_stages": 2,
                    "pipeline_microbatches": 4, **kw}),
             input_kind="tokens", param_count=0),
+        # 4 layers over 4 stages (1 layer/stage): stage count divisible by
+        # pipeline mesh axes 1/2/4, so one model can re-form across
+        # pipeline degrees — the cross-axis elastic soak geometry
+        # (tests/test_elastic_resume.py, launch.py --elastic-geometry).
+        # 2 microbatches keeps the tick count (M+P-1) minimal: the soak
+        # measures re-formation outage, and the first post-resume step is
+        # on that clock. Dropout off: flax derives dropout masks from the
+        # module tree, and re-grouping layers into stages changes that
+        # tree — so across a pipeline-degree change the masks are
+        # legitimately different random draws. Zeroing dropout makes the
+        # uninterrupted run a valid parity reference; everything else
+        # about the cross-axis path is mask-independent.
+        "bert_tiny_pp44": ModelSpec(
+            name="bert_tiny_pp44", objective="mlm",
+            build=lambda **kw: bert.tiny_bert_mlm(
+                **{"num_layers": 4, "pipeline_stages": 4,
+                   "pipeline_microbatches": 2, "dropout_rate": 0.0, **kw}),
+            input_kind="tokens", param_count=0),
     }
 
 

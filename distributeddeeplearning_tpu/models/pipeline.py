@@ -273,8 +273,22 @@ class PipelinedEncoder(nn.Module):
                 f"batch {b} not divisible by num_microbatches={m}")
         mb = b // m
 
-        micro = x.reshape(m, mb, s, h)
-        micro_mask = mask.reshape(m, mb, s)
+        # Strided microbatching: microbatch j = examples j::m, i.e. the
+        # (B,) -> (mb, m) reshape keeps the *batch* dim outermost. Under a
+        # data-sharded batch this is a shard-local relabel — no cross-shard
+        # movement — which both saves an all-to-all and sidesteps an XLA
+        # SPMD propagation miscompile observed on jax 0.4.37: the
+        # contiguous (m, mb) split of a sharded batch dim materializes the
+        # shard-local grouping while the program's global semantics name
+        # the contiguous one, silently feeding each microbatch a different
+        # set of examples per mesh (the dp1-vs-dp4 forward then disagrees
+        # at activation scale; see tests/test_pipeline.py's dp-invariance
+        # test). Per-example math is grouping-invariant, so which examples
+        # share a microbatch is semantically free — strided makes it also
+        # layout-free.
+        micro = nn.with_logical_constraint(
+            x.reshape(mb, m, s, h), ("batch", None, "seq", "embed"))
+        micro_mask = mask.reshape(mb, m, s)
         state = jnp.zeros((p, mb, s, h), x.dtype)
         state_mask = jnp.ones((p, mb, s), mask.dtype)
 
@@ -315,10 +329,10 @@ class PipelinedEncoder(nn.Module):
             if inject is not None:
                 # Stage 0 takes a fresh microbatch; k -> k+1 shift behind
                 # it. XLA: collective-permute over ICI.
-                state = jnp.concatenate([micro[inject][None], state[:-1]],
+                state = jnp.concatenate([micro[:, inject][None], state[:-1]],
                                         axis=0)
                 state_mask = jnp.concatenate(
-                    [micro_mask[inject][None], state_mask[:-1]], axis=0)
+                    [micro_mask[:, inject][None], state_mask[:-1]], axis=0)
             else:
                 # Circular shift: stage 0 re-enters the ring at the next
                 # chunk (1f1b wrap) or chews dead state (gpipe drain).
@@ -341,7 +355,10 @@ class PipelinedEncoder(nn.Module):
                 outputs.append((tick.emit_mb, state[-1]))
 
         outputs.sort(key=lambda kv: kv[0])  # already monotone; belt+braces
-        out = jnp.concatenate([o for _, o in outputs], axis=0)
+        # Inverse of the strided split: stack microbatches on dim 1 so
+        # row i*m + j recovers input example i*m + j — a local reshape
+        # again, output rows stay aligned with input rows on every mesh.
+        out = jnp.stack([o for _, o in outputs], axis=1)
         return out.reshape(b, s, h)
 
     def _interleave(self, leaf):

@@ -16,6 +16,16 @@ the log cadence and flags four families:
     straggler_trending                   one host slow for N intervals
     bad_step                             the compiled guard tripped
 
+Launcher-side signal (fed by ``launch.run_with_restarts`` on each planned
+elastic re-formation via ``update_elastic()``):
+
+    elastic_reconfig                     re-formation storm — membership
+                                         churning faster than training can
+                                         amortize (flapping host, bad
+                                         autoscaler); a handful of planned
+                                         re-formations is normal and stays
+                                         quiet
+
 Serve-side signals (fed by the replica / bench on the same cadence via
 ``update_serve()``):
 
@@ -87,7 +97,9 @@ class AnomalyDetector:
                  deadline_miss_threshold: float = 0.25,
                  spec_collapse_frac: float = 0.25,
                  spec_median_floor: float = 0.2,
-                 spec_min_proposed: int = 4):
+                 spec_min_proposed: int = 4,
+                 elastic_storm_min: int = 4,
+                 elastic_storm_window_s: float = 600.0):
         self.min_samples = int(min_samples)
         self.loss_margin = float(loss_margin)
         self.loss_mad_k = float(loss_mad_k)
@@ -103,11 +115,14 @@ class AnomalyDetector:
         self.spec_collapse_frac = float(spec_collapse_frac)
         self.spec_median_floor = float(spec_median_floor)
         self.spec_min_proposed = int(spec_min_proposed)
+        self.elastic_storm_min = int(elastic_storm_min)
+        self.elastic_storm_window_s = float(elastic_storm_window_s)
         self._loss: deque = deque(maxlen=window)
         self._grad: deque = deque(maxlen=window)
         self._eps: deque = deque(maxlen=window)
         self._queue: deque = deque(maxlen=window)
         self._accept: deque = deque(maxlen=window)
+        self._reforms: deque = deque(maxlen=max(window, 32))
         self._straggler_streak = 0
 
     def update(self, step: int, *, loss: Any = None, grad_norm: Any = None,
@@ -276,6 +291,35 @@ class AnomalyDetector:
                              "wasted")
                 self._accept.append(rate)
 
+        return out
+
+    def update_elastic(self, t_s: Any, *, epoch: Any = None) -> list[dict]:
+        """Feed one planned elastic re-formation (``t_s``: monotonic
+        seconds at commit). Flags ``elastic_reconfig`` only when
+        ``elastic_storm_min`` or more re-formations land inside one
+        ``elastic_storm_window_s`` window — membership is churning faster
+        than training can re-amortize its compile/restore cost (a flapping
+        host, an autoscaler oscillating). The acceptance soaks' two-or-
+        three planned re-formations stay far below the floor, keeping the
+        zero-false-positive discipline."""
+        out: list[dict] = []
+        t = _finite(t_s)
+        if t is None:
+            return out
+        self._reforms.append(t)
+        recent = [x for x in self._reforms
+                  if t - x <= self.elastic_storm_window_s]
+        if len(recent) >= self.elastic_storm_min:
+            out.append({
+                "kind": "elastic_reconfig",
+                "step": int(epoch) if epoch is not None else 0,
+                "value": float(len(recent)),
+                "baseline": float(self.elastic_storm_min),
+                "detail": (f"{len(recent)} elastic re-formations inside "
+                           f"{self.elastic_storm_window_s:.0f}s (epoch "
+                           f"{epoch}) — membership is flapping; training "
+                           "cannot amortize reconfiguration cost"),
+            })
         return out
 
 

@@ -102,6 +102,19 @@ class Zero1Layout:
                 f"{self.num_leaves} leaves, pad {padded - total} elems")
 
 
+def stage_index(optimizer_sharding: Optional[str]) -> int:
+    """The ZeRO stage number of an ``--optimizer-sharding`` mode (none -> 0,
+    zero1 -> 1, ...). Used by cross-axis elastic re-formation to describe a
+    stage change (``zero2 -> none``) in resume announcements and sidecars —
+    the canonical on-disk layout is stage-agnostic, so any pair is legal."""
+    mode = (optimizer_sharding or "none").strip().lower()
+    if mode in ("", "none"):
+        return 0
+    if mode.startswith("zero") and mode[4:].isdigit():
+        return int(mode[4:])
+    raise ValueError(f"unknown optimizer-sharding mode {optimizer_sharding!r}")
+
+
 def build_layout(tree, axis_size: int,
                  bucket_bytes: Optional[int] = None) -> Zero1Layout:
     """Plan the ZeRO-1 chunk layout for ``tree`` (arrays or shape structs —

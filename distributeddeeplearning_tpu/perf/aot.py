@@ -70,7 +70,12 @@ VOLATILE_DATA_FIELDS = frozenset({
 def _versions() -> dict[str, str]:
     import jax
     import jaxlib
-    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+    # The RNG lowering is part of the compiled program: an executable built
+    # under legacy threefry replays legacy bits forever, so a flag flip
+    # (set in the package __init__) must miss the cache, not poison it.
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "threefry_partitionable":
+                str(bool(jax.config.jax_threefry_partitionable))}
 
 
 def config_fingerprint(config, *, total_steps: Optional[int] = None,

@@ -47,6 +47,18 @@ Kinds
   next step boundary (graceful preemption save) and re-forms at the grown
   degree. Fired from a *surviving* process — the dead host has no process
   to fire from.
+- ``host_join@N``   — rendezvous-scoped grow: after step N, announce a NEW
+  host at the rendezvous (same marker file as ``host_rejoin``, kind-tagged
+  ``host_join``), then keep training. The membership controller raises the
+  reform barrier; every member drains voluntarily at its next step boundary
+  (exit code 75) and the job re-forms at the grown degree — no teardown of
+  surviving children.
+- ``host_drain@N``  — rendezvous-scoped planned leave: after step N, write
+  this host's drain marker (original host id from ``DDL_ELASTIC_HOST``),
+  then keep training. Unlike ``host_lost`` every member is still alive, so
+  the barrier is save-capable: members checkpoint collectively before
+  exiting and the re-formed attempt resumes one step behind the drain
+  point, not from the last periodic save.
 
 Serve-scoped kinds (fired at ``serve/engine.py`` step boundaries; ``crash``
 and ``sigkill`` are shared with training and mean the same thing there —
@@ -92,13 +104,14 @@ ALWAYS = -1  # Fault.attempt sentinel: fire on every restart attempt
 KINDS = frozenset({
     "crash", "sigterm", "sigkill", "nan_grads", "loader_stall",
     "corrupt_latest_ckpt", "host_lost", "host_rejoin",
+    "host_join", "host_drain",
     "page_leak", "decode_stall", "corrupt_page_table",
 })
 # Faults the train loop fires between steps (vs nan_grads: compiled into the
 # step; loader_stall: injected into the data source).
 _PROCESS_KINDS = frozenset({
     "crash", "sigterm", "sigkill", "corrupt_latest_ckpt",
-    "host_lost", "host_rejoin"})
+    "host_lost", "host_rejoin", "host_join", "host_drain"})
 # Faults the serve engine understands. crash/sigkill are shared with
 # training; the rest only make sense against a live engine.
 SERVE_KINDS = frozenset({
@@ -389,6 +402,30 @@ def _fire_one(fault: Fault, step: int, ckpt, checkpoint_dir) -> None:
                   f"{step}", file=sys.stderr, flush=True)
         else:
             print(f"# fault injection: host_rejoin@{step} ignored — no "
+                  f"{health.ENV_HEARTBEAT_DIR} (not under a heartbeat-"
+                  f"armed launcher)", file=sys.stderr, flush=True)
+    elif fault.kind == "host_join":
+        from distributeddeeplearning_tpu.observability import health
+
+        directory = os.environ.get(health.ENV_HEARTBEAT_DIR)
+        if directory:
+            health.announce_join(directory)
+            print(f"# fault injection: host join announced after step "
+                  f"{step}", file=sys.stderr, flush=True)
+        else:
+            print(f"# fault injection: host_join@{step} ignored — no "
+                  f"{health.ENV_HEARTBEAT_DIR} (not under a heartbeat-"
+                  f"armed launcher)", file=sys.stderr, flush=True)
+    elif fault.kind == "host_drain":
+        from distributeddeeplearning_tpu.observability import health
+
+        directory = os.environ.get(health.ENV_HEARTBEAT_DIR)
+        if directory:
+            health.announce_drain(directory)
+            print(f"# fault injection: host drain announced after step "
+                  f"{step}", file=sys.stderr, flush=True)
+        else:
+            print(f"# fault injection: host_drain@{step} ignored — no "
                   f"{health.ENV_HEARTBEAT_DIR} (not under a heartbeat-"
                   f"armed launcher)", file=sys.stderr, flush=True)
     elif fault.kind == "crash":

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -93,6 +94,11 @@ class Checkpointer:
         self._directory = os.path.abspath(directory)  # orbax rejects
         self._max_to_keep = max_to_keep               # relative paths
         self._mgr = self._make_manager()
+        # Wall seconds the last successful restore_latest spent (None until
+        # one runs). Feeds the elastic reconfiguration phase breakdown:
+        # restore time vs compile time decides whether the overlap is
+        # actually hiding anything.
+        self.last_restore_s: Optional[float] = None
 
     def _make_manager(self) -> ocp.CheckpointManager:
         return ocp.CheckpointManager(
@@ -227,8 +233,12 @@ class Checkpointer:
         loudly: silently discarding trained state contradicts the repo's
         dead-knob policy, and before this check it surfaced as an opaque
         orbax structure-mismatch error (ADVICE r3 #2)."""
-        return self._with_fallback(
+        t0 = time.perf_counter()
+        restored = self._with_fallback(
             lambda step: self._restore_latest_at(step, state_like))
+        if restored is not None:
+            self.last_restore_s = time.perf_counter() - t0
+        return restored
 
     def _restore_latest_at(self, step: int, state_like: Any) -> Any:
         if self._converter is not None:

@@ -261,14 +261,18 @@ def build(config: TrainConfig, total_steps: int):
         example = synthetic.make_source(
             config, spec.input_kind, sharding=batch_shd,
             objective=spec.objective).batch(0)
-        state, shardings = steps.init_sharded_state(
-            model, tx, mesh, config, example, rng, spec.input_kind)
         # Same AOT executable cache as the explicit-DP path below: a warm
         # boot of an identical config (pipelined runs included — the
         # schedule is part of the fingerprint) deserializes the step with
-        # zero retraces instead of re-tracing the whole tick loop.
+        # zero retraces instead of re-tracing the whole tick loop. Created
+        # BEFORE init so the init program rides the same cache — on a
+        # re-formed elastic attempt the init compile is pure spawn_s
+        # outage (restore overwrites its values), so it loads warm too.
         aot = aotlib.StepExecutableCache.for_config(
             config, total_steps=total_steps)
+        state, shardings = steps.init_sharded_state(
+            model, tx, mesh, config, example, rng, spec.input_kind,
+            aot=aot)
         train_step = steps.make_gspmd_train_step(
             model, tx, mesh, config, shardings, spec.input_kind,
             spec.objective, aot=aot)
@@ -464,6 +468,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     start_step = 0
     resolved_loader = datalib.resolve_loader(config, spec.input_kind)
     live_degree = meshlib.data_parallel_degree(config.parallel)
+    # The explicit-DP step carries its stage as an attribute; the GSPMD
+    # zero2∘pipeline composition shards via NamedSharding rules and has no
+    # such attribute, so fall back to the configured stage — the stream
+    # metadata (and the cross-axis announcement below) must name the stage
+    # that actually ran, whichever path built the step.
+    live_stage = (getattr(train_step, "zero_stage", None)
+                  or config.optimizer_sharding or "none")
+    live_pp = int(config.parallel.pipeline)
     prior_meta: dict = {}
     if ckpt is not None:
         # Pin the environment-dependent loader resolution to the checkpoint:
@@ -485,9 +497,48 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         meta = {"loader": resolved_loader, "opt_state_layout": "canonical"}
         if not restore_for_eval:
             meta["global_batch_size"] = int(config.global_batch_size)
+        # optimizer_sharding / pipeline_degree join mesh_degree as
+        # informational (rewritten each run): the canonical layout makes
+        # checkpoints interchangeable across ZeRO stages and pipeline
+        # degrees, so a cross-axis re-formation is announced, not refused.
         prior_meta = ckpt.verify_or_record_stream_meta(
-            meta, update={"mesh_degree": live_degree})
+            meta, update={"mesh_degree": live_degree,
+                          "optimizer_sharding": live_stage,
+                          "pipeline_degree": live_pp})
+    # The membership event of a re-formed elastic attempt (exported by the
+    # launcher as DDL_ELASTIC_EVENT): detect_t is CLOCK_MONOTONIC at fault
+    # detection, the same clock telemetry.now_s() reads in this process, so
+    # the first post-resume step closes the reconfiguration_time_s span.
+    # Read BEFORE restore: a re-formed attempt overlaps its warm compile
+    # against the restore below.
+    elastic_event = health.read_elastic_event()
     if ckpt is not None and config.resume:
+        warm_thread = None
+        if (elastic_event is not None and not restore_for_eval
+                and getattr(train_step, "warm", None) is not None):
+            # Re-formation fast path: kick the train-step compile off on a
+            # background thread (abstract avals from the pre-restore state
+            # template + one throwaway batch at the latest-step hint) while
+            # orbax restores — the detect->first-step outage then pays
+            # max(restore, compile), not their sum. Failures silently leave
+            # the cold path in place, like the evaluator's warm compile.
+            hint = ckpt.latest_step()
+            if hint is not None and int(hint) < total_steps:
+                try:
+                    warm_src = datalib.make_source(
+                        config, spec.input_kind, batch_shd,
+                        start_step=int(hint), objective=spec.objective)
+                    warm_batch = warm_src.batch(int(hint))
+                    state_struct = jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            x.shape, x.dtype, sharding=x.sharding), state)
+                    warm_thread = threading.Thread(
+                        target=train_step.warm,
+                        args=(state_struct, warm_batch, rng),
+                        daemon=True, name="ddl-reform-warm-compile")
+                    warm_thread.start()
+                except Exception:  # noqa: BLE001 - warm-up is optional
+                    warm_thread = None
         # restore_for_eval: params/BN/step only, fresh optimizer state — an
         # eval-only consumer must not have to repeat the training run's
         # optimizer flags to satisfy the full-state structure match.
@@ -523,11 +574,34 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     "elastic:cross_degree_resume", step=start_step,
                     degree_before=int(prior_degree),
                     degree_after=live_degree)
-    # The membership event of a re-formed elastic attempt (exported by the
-    # launcher as DDL_ELASTIC_EVENT): detect_t is CLOCK_MONOTONIC at fault
-    # detection, the same clock telemetry.now_s() reads in this process, so
-    # the first post-resume step closes the reconfiguration_time_s span.
-    elastic_event = health.read_elastic_event()
+            # Cross-AXIS resume: the previous attempt ran a different ZeRO
+            # stage and/or pipeline degree. The canonical (parameter-shaped)
+            # on-disk layout restored bitwise onto this plan; announce so an
+            # operator reading the log sees the axes crossed, not just the
+            # degree.
+            prior_stage = prior_meta.get("optimizer_sharding")
+            prior_pp = prior_meta.get("pipeline_degree")
+            axis_changes = []
+            if prior_stage is not None and str(prior_stage) != live_stage:
+                axis_changes.append(
+                    f"optimizer sharding {prior_stage} -> {live_stage}")
+            if prior_pp is not None and int(prior_pp) != live_pp:
+                axis_changes.append(f"pipeline {int(prior_pp)} -> {live_pp}")
+            if axis_changes:
+                if jax.process_index() == 0:
+                    print("# elastic: cross-axis resume — "
+                          + ", ".join(axis_changes)
+                          + " (canonical layout; trajectory preserved "
+                            "through the converter)",
+                          file=sys.stderr, flush=True)
+                telemetry.get().instant(
+                    "elastic:cross_axis_resume", step=start_step,
+                    optimizer_sharding=live_stage, pipeline_degree=live_pp)
+        if warm_thread is not None:
+            # Join before the first dispatch: either the executable is
+            # ready (the dispatch below hits the warm cache) or the warm
+            # compile failed and the dispatch compiles cold — never both.
+            warm_thread.join()
     flight = flightlib.get()
     flight.record("run_start", step=start_step, total_steps=int(total_steps),
                   degree=live_degree, model=config.model,
@@ -693,6 +767,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     overlap_frac: Optional[float] = None
     pipeline_bubble: Optional[float] = None
     reconfig_time_s: Optional[float] = None
+    reconfig_phases: Optional[dict] = None
     try:
         i = start_step  # steps completed so far
         while i < total_steps:
@@ -706,6 +781,41 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 raise SystemExit(
                     f"preempted (signal {preempted['signum']}): "
                     f"checkpoint saved at step {i}")
+            if heartbeat is not None:
+                # Rendezvous membership (launch.py --elastic): the launcher
+                # raised the reform barrier — a host joined, announced a
+                # drain, or was lost. Exit EXIT_DRAIN voluntarily at this
+                # step boundary so the job re-forms WITHOUT any survivor
+                # being torn down. A barrier at our own epoch (the one that
+                # formed us) reads as None.
+                barrier = health.poll_drain()
+                if barrier is not None:
+                    saved = False
+                    if ckpt is not None and barrier.get("save", True):
+                        # Every member is alive (the launcher only marks
+                        # save-capable barriers when the membership is
+                        # whole), so the collective save completes and the
+                        # re-formed attempt resumes from THIS step instead
+                        # of the last cadence save.
+                        ckpt.maybe_save(i, state, force=True)
+                        ckpt.wait()
+                        saved = True
+                    tele.instant("elastic:drain", step=int(i),
+                                 epoch=barrier.get("epoch"))
+                    flight.record("drain", step=int(i),
+                                  epoch=barrier.get("epoch"),
+                                  trigger=barrier.get("trigger"),
+                                  saved=saved)
+                    if jax.process_index() == 0:
+                        print(f"# elastic: reform barrier (epoch "
+                              f"{barrier.get('epoch')}, trigger "
+                              f"{barrier.get('trigger')}) — draining at "
+                              f"step {i}"
+                              + (" after a collective save" if saved else
+                                 " without saving (a member is already "
+                                 "gone)"),
+                              file=sys.stderr, flush=True)
+                    raise SystemExit(health.EXIT_DRAIN)
             n = (min(config.steps_per_loop, _next_boundary(i) - i)
                  if fused_runner is not None else 1)
             profile.before_step(i)
@@ -739,7 +849,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 # latency. One extra sync on step one only — numerics and
                 # steady-state timing are untouched.
                 compile_time_s = time.perf_counter() - t_step0
+                t_fetch0 = time.perf_counter()
                 jax.device_get(metrics)
+                first_step_exec_s = time.perf_counter() - t_fetch0
                 time_to_first_step_s = time.perf_counter() - t_origin
                 compile_pending = compile_time_s
                 tele.gauge("compile_time_s", round(compile_time_s, 3),
@@ -752,10 +864,43 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     # this first post-resume step, both ends on the shared
                     # local CLOCK_MONOTONIC. Covers teardown, relaunch,
                     # restore, and recompile — the operator-visible outage.
-                    reconfig_time_s = (telemetry.now_s()
-                                       - float(elastic_event["detect_t"]))
+                    detect_t = float(elastic_event["detect_t"])
+                    reconfig_time_s = telemetry.now_s() - detect_t
                     tele.gauge("reconfiguration_time_s",
                                round(reconfig_time_s, 3), step=int(i))
+                    # Phase breakdown of the outage (all on the shared
+                    # CLOCK_MONOTONIC): detect -> last member drained
+                    # (launcher clock), restore (orbax wall time), compile
+                    # (first dispatch host-block — near zero when the warm
+                    # overlap landed), first-step execution; spawn_s is the
+                    # remainder (relaunch + imports + device init). With
+                    # the restore/compile overlap the parts can overlap in
+                    # wall time, so they need not sum to total_s.
+                    drain_done = elastic_event.get("drain_done_t")
+                    drain_s = (max(0.0, float(drain_done) - detect_t)
+                               if isinstance(drain_done, (int, float))
+                               else None)
+                    restore_s = (ckpt.last_restore_s
+                                 if ckpt is not None else None)
+                    known = sum(v for v in (drain_s, restore_s,
+                                            compile_time_s,
+                                            first_step_exec_s)
+                                if v is not None)
+                    reconfig_phases = {
+                        "total_s": round(reconfig_time_s, 3),
+                        "drain_s": (round(drain_s, 3)
+                                    if drain_s is not None else None),
+                        "restore_s": (round(restore_s, 3)
+                                      if restore_s is not None else None),
+                        "compile_s": round(compile_time_s, 3),
+                        "first_step_s": round(first_step_exec_s, 3),
+                        "spawn_s": round(
+                            max(0.0, reconfig_time_s - known), 3),
+                    }
+                    for k, v in reconfig_phases.items():
+                        if k != "total_s" and v is not None:
+                            tele.gauge(f"reconfiguration_{k}", v,
+                                       step=int(i))
                     # The outage span, closed: the launcher recorded the
                     # re-formation *plan*; this records it *landed*.
                     flight.record(
@@ -763,7 +908,9 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                         trigger=elastic_event.get("trigger"),
                         degree_before=elastic_event.get("degree_before"),
                         degree_after=elastic_event.get("degree_after"),
+                        epoch=elastic_event.get("epoch"),
                         reconfiguration_time_s=round(reconfig_time_s, 3),
+                        phases=reconfig_phases,
                         resume_step=start_step)
                 if tele.enabled and getattr(train_step, "zero_stage", None):
                     # Backward/collective overlap gauge: fraction of the
@@ -916,10 +1063,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     if elastic_event is not None:
         summary["elastic_event"] = {
             k: elastic_event.get(k)
-            for k in ("trigger", "degree_before", "degree_after")}
+            for k in ("trigger", "degree_before", "degree_after", "epoch")}
         if reconfig_time_s is not None:
             summary["reconfiguration_time_s"] = round(reconfig_time_s, 3)
-        _write_elastic_sidecar(elastic_event, reconfig_time_s, start_step)
+        if reconfig_phases is not None:
+            summary["reconfiguration_phases"] = reconfig_phases
+        _write_elastic_sidecar(elastic_event, reconfig_time_s, start_step,
+                               phases=reconfig_phases)
     if getattr(train_step, "zero_stage", None) is not None:
         summary["optimizer_sharding"] = {
             "stage": train_step.zero_stage,
@@ -1157,7 +1307,8 @@ def _elastic_sidecar_path() -> str:
     return sidecars.path_for("last_elastic_event")
 
 
-def _write_elastic_sidecar(event, reconfig_time_s, resume_step) -> None:
+def _write_elastic_sidecar(event, reconfig_time_s, resume_step,
+                           phases=None) -> None:
     """Record the re-formation this attempt resumed under where
     tools/doctor.py looks (best-effort, like the sharding sidecar)."""
     if jax.process_index() != 0:
@@ -1166,9 +1317,11 @@ def _write_elastic_sidecar(event, reconfig_time_s, resume_step) -> None:
         "trigger": event.get("trigger"),
         "degree_before": event.get("degree_before"),
         "degree_after": event.get("degree_after"),
+        "epoch": event.get("epoch"),
         "reconfiguration_time_s": (round(reconfig_time_s, 3)
                                    if reconfig_time_s is not None
                                    else None),
+        "phases": phases,
         "resume_step": int(resume_step),
     })
 

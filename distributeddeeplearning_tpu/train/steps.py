@@ -524,6 +524,33 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             return aot_exec["fn"](state, batch, rng)
         return jitted(state, batch, rng)
 
+    def warm(state_struct, batch, rng) -> bool:
+        """Resolve the step executable from abstract avals without
+        executing. The elastic restore/compile overlap (train/loop.py) runs
+        this on a background thread while ``restore_latest`` deserializes
+        the checkpoint, so a re-formed attempt pays max(restore, compile)
+        instead of their sum. ``state_struct`` must carry the live state's
+        shardings (ShapeDtypeStruct with sharding=) — same contract as the
+        evaluator's warm_compile_async. Returns False (cold path intact) on
+        any failure; warm-up is optional."""
+        if aot_exec["fn"] is not None:
+            return True
+        try:
+            if aot is not None and aot.enabled:
+                fn = _aot_acquire(aot, "dp_train_step", jitted,
+                                  (state_struct, batch, rng))
+            else:
+                t0 = time.perf_counter()
+                fn = jitted.lower(state_struct, batch, rng).compile()
+                telemetry.get().record_span("compile", t0,
+                                            time.perf_counter())
+            aot_exec["fn"] = fn
+            aot_exec["resolved"] = True
+            return True
+        except Exception:  # noqa: BLE001 - warm-up is optional
+            return False
+
+    compiled.warm = warm
     # Raw traceable step for the fused multi-step loop
     # (make_fused_train_loop): shard_map composes under an outer jit+scan.
     compiled.raw_step = mapped
@@ -671,10 +698,16 @@ def _zero2_opt_state_shardings(mesh: Mesh, abstract_opt, shardings_opt):
 
 def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
                        example_batch: Any, rng: jax.Array,
-                       input_kind: str = "tokens"):
+                       input_kind: str = "tokens", aot=None):
     """Initialize a TrainState whose params/opt-state are laid out per the
     logical sharding rules, created directly on-device via jit out_shardings
-    (no host-side full materialization)."""
+    (no host-side full materialization).
+
+    With an ``aot`` cache the init program itself is fingerprint-keyed like
+    the train step: a re-formed elastic attempt (or any warm boot of an
+    identical config) deserializes it instead of re-compiling — the init
+    values are overwritten by the checkpoint restore anyway, so the compile
+    it skips was pure outage time (reconfiguration ``spawn_s``)."""
 
     def init_fn(rng):
         with _unreplicated_rules_ctx(config):
@@ -706,7 +739,10 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
         shardings = shardings.replace(opt_state=_zero2_opt_state_shardings(
             mesh, abstract.opt_state, shardings.opt_state))
     with use_mesh(mesh):
-        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+        jitted = jax.jit(init_fn, out_shardings=shardings)
+        if aot is not None and aot.enabled:
+            jitted = _aot_acquire(aot, "gspmd_init", jitted, (rng,))
+        state = jitted(rng)
     return state, shardings
 
 
@@ -726,7 +762,13 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
             # Microbatching under GSPMD: the (B,) -> (A, B/A) reshape crosses
             # the dp sharding, so XLA may insert a small resharding collective
             # on the *batch* (token batches are tiny; image configs use the
-            # shard-local DP path above instead).
+            # shard-local DP path above instead). Caveat: SPMD propagation
+            # has been observed (jax 0.4.37) to realize this contiguous
+            # split as the shard-local grouping — for a loss that is a plain
+            # per-example mean the accumulated gradient is grouping-
+            # invariant, but it is NOT guaranteed mesh-stable for
+            # group-normalized losses; the pipeline conveyor hit the same
+            # pattern and moved to a strided split (models/pipeline.py).
             grads, new_bn, metrics = accumulated_grads(
                 loss_fn, state.params, state.batch_stats, batch, rng,
                 config.grad_accum_steps)
@@ -780,6 +822,36 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
         with use_mesh(mesh):
             return jit_cache[key](state, batch, rng)
 
+    def warm(state_struct, batch, rng) -> bool:
+        """GSPMD twin of the DP path's ``warm``: populate the per-structure
+        cache from abstract avals (elastic restore/compile overlap). The
+        explicit in_shardings make struct lowering exact — the executable
+        the first real call would have built."""
+        key = jax.tree_util.tree_structure(batch)
+        if key in jit_cache:
+            return True
+        try:
+            jitted = jax.jit(
+                step_fn,
+                in_shardings=(state_shardings, batch_shardings(batch),
+                              NamedSharding(mesh, P())),
+                out_shardings=(state_shardings, NamedSharding(mesh, P())),
+                donate_argnums=0)
+            with use_mesh(mesh):
+                if aot is not None and aot.enabled:
+                    jitted = _aot_acquire(aot, "gspmd_train_step", jitted,
+                                          (state_struct, batch, rng))
+                else:
+                    t0 = time.perf_counter()
+                    jitted = jitted.lower(state_struct, batch, rng).compile()
+                    telemetry.get().record_span("compile", t0,
+                                                time.perf_counter())
+            jit_cache[key] = jitted
+            return True
+        except Exception:  # noqa: BLE001 - warm-up is optional
+            return False
+
+    compiled.warm = warm
     compiled.raw_step = step_fn
     compiled.state_shardings = state_shardings
     return compiled

@@ -141,23 +141,53 @@ from tools.marker_audit import audit_elastic  # noqa: E402
 def test_audit_elastic_clean_run():
     records = [_rec("t::fast", 1.0),
                {**_rec("t::fast_cross_degree", 20.0), "elastic": True},
-               {**_rec("t::soak", 300.0, slow=True), "elastic": True}]
+               {**_rec("t::test_survivor_selection_grid", 1.0),
+                "elastic": True},
+               {**_rec("t::test_cross_axis_soak", 300.0, slow=True),
+                "elastic": True}]
     assert audit_elastic(records) == []
 
 
 def test_audit_elastic_flags_no_coverage():
     problems = audit_elastic([_rec("t::fast", 1.0)])
-    assert len(problems) == 1
+    assert len(problems) == 2
     assert "no elastic-marked test ran" in problems[0]
+    assert "survivor-selection" in problems[1]
 
 
 def test_audit_elastic_flags_all_slow():
     """The soak is legitimately slow, but if EVERY elastic test is slow the
     cross-degree resume path silently leaves tier-1 (-m 'not slow')."""
-    records = [{**_rec("t::soak", 300.0, slow=True), "elastic": True}]
+    records = [{**_rec("t::test_cross_axis_soak", 300.0, slow=True),
+                "elastic": True},
+               {**_rec("t::test_survivor_selection_grid", 300.0, slow=True),
+                "elastic": True}]
     problems = audit_elastic(records)
     assert len(problems) == 1
     assert "every elastic-marked test is also marked slow" in problems[0]
+
+
+def test_audit_elastic_requires_survivor_grid():
+    """Rendezvous extension: the topology-aware shrink's deterministic
+    survivor choice must stay pinned in EVERY selection."""
+    records = [{**_rec("t::fast_cross_degree", 20.0), "elastic": True}]
+    problems = audit_elastic(records)
+    assert len(problems) == 1
+    assert "survivor-selection" in problems[0]
+
+
+def test_audit_elastic_requires_cross_axis_when_slow_runs():
+    """When the selection includes slow tests at all, the cross-axis soak
+    (ZeRO stage + pipeline degree changing mid-run) must be among them."""
+    base = [{**_rec("t::fast_cross_degree", 20.0), "elastic": True},
+            {**_rec("t::test_survivor_selection_grid", 1.0),
+             "elastic": True}]
+    # Fast-only selection: the soak is legitimately absent.
+    assert audit_elastic(base) == []
+    slow_run = base + [_rec("t::unrelated_soak", 200.0, slow=True)]
+    problems = audit_elastic(slow_run)
+    assert len(problems) == 1
+    assert "cross_axis" in problems[0]
 
 
 def test_cli_expect_elastic_flag(tmp_path):
@@ -176,7 +206,9 @@ def test_cli_expect_elastic_flag(tmp_path):
     full.write_text(json.dumps(
         [{**_rec("t::gate", 5.0), "perf_gate": True},
          {**_rec("t::gate_zero2_overlap", 5.0), "perf_gate": True},
-         {**_rec("t::fast_cross_degree", 20.0), "elastic": True}]))
+         {**_rec("t::fast_cross_degree", 20.0), "elastic": True},
+         {**_rec("t::test_survivor_selection_grid", 1.0),
+          "elastic": True}]))
     assert subprocess.run(
         cmd + [str(full), "--expect-perf-gate", "--expect-elastic"],
     ).returncode == 0

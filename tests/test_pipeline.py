@@ -56,6 +56,57 @@ def test_pipeline_matches_sequential():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_pipeline_forward_mesh_invariant(devices8):
+    """Same params + batch -> bitwise-identical logits on every (data,
+    pipeline) mesh factorization. Guards the strided microbatch split: the
+    old contiguous (m, mb) reshape of a data-sharded batch dim let XLA SPMD
+    propagation (observed on jax 0.4.37) materialize the shard-local
+    example grouping under the global grouping's name, so each mesh fed
+    each microbatch a *different* set of examples — surfacing as per-step
+    trajectory drift whenever the elastic controller re-formed across dp
+    (tests/test_elastic_resume.py), not as any visible shape error."""
+    from jax.sharding import NamedSharding
+    from distributeddeeplearning_tpu.models import get_model
+    from distributeddeeplearning_tpu.parallel import sharding as shardlib
+    from distributeddeeplearning_tpu.parallel.mesh import use_mesh
+
+    model = get_model("bert_tiny_pp44", vocab_size=1024, dtype=jnp.float32)
+    src = SyntheticTokens(8, 16, 1024, seed=0)
+    batch = src.batch(2)
+    init_rules = list(shardlib.logical_rules(
+        ParallelConfig(data=1, pipeline=2)))
+    with nn.logical_axis_rules(init_rules):
+        params = nn.meta.unbox(model.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(0)},
+            batch["input_ids"], train=False))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+
+    def logits_under(dp, pp):
+        par = ParallelConfig(data=dp, pipeline=pp)
+        mesh = make_mesh(par)
+        bshd = shardlib.batch_sharding(mesh, seq_dim=1)
+        rules = list(shardlib.logical_rules(par))
+
+        def fwd(p, ids, am):
+            with nn.logical_axis_rules(rules):
+                out, _ = model.apply({"params": p}, ids, attention_mask=am,
+                                     train=False, mutable=["moe_losses"])
+            return out
+
+        jitted = jax.jit(fwd,
+                         in_shardings=(NamedSharding(mesh, P()), bshd, bshd),
+                         out_shardings=NamedSharding(mesh, P()))
+        with use_mesh(mesh):
+            return np.asarray(jitted(params, batch["input_ids"],
+                                     batch["attention_mask"]))
+
+    ref = logits_under(1, 2)
+    for dp, pp in ((4, 2), (2, 4), (1, 4)):
+        got = logits_under(dp, pp)
+        np.testing.assert_array_equal(
+            got, ref, err_msg=f"pipelined forward differs on dp={dp} pp={pp}")
+
+
 def _pp_cfg():
     return TrainConfig(
         model="bert_tiny_pp", global_batch_size=8, dtype="float32",

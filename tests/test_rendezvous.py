@@ -1,0 +1,376 @@
+"""Rendezvous membership units: topology-aware survivor selection, the
+epoch/barrier protocol in observability/health.py, the geometry-aware
+ElasticController, the host_join/host_drain fault kinds, the serve
+AutoscalePolicy, and the elastic_reconfig storm anomaly.
+
+Everything here is fast and jax-free (the launcher side must never import
+jax); the end-to-end drain/re-form/restore behavior lives in the slow
+cross-axis soak in tests/test_elastic_resume.py. The whole module carries
+the elastic marker — tools/marker_audit.py --expect-elastic requires a
+"survivor"-named elastic test in every tier-1 selection.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributeddeeplearning_tpu import hostmesh, launch
+from distributeddeeplearning_tpu.observability import anomaly
+from distributeddeeplearning_tpu.observability import flight as flightlib
+from distributeddeeplearning_tpu.observability import health
+from distributeddeeplearning_tpu.robustness import faults
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware survivor selection (hostmesh.select_survivors)
+# ---------------------------------------------------------------------------
+
+def test_survivor_selection_grid_deterministic_and_contiguous():
+    """Across a grid of (ring size, live subset, target k): the choice is
+    deterministic, partitions the candidates, and — whenever every host is
+    still alive — lands on one unbroken ICI arc."""
+    for n in (4, 8):
+        full = list(range(n))
+        subsets = [full] + [
+            [h for h in full if h != dead] for dead in (0, n // 2, n - 1)
+        ] + [[h for h in full if h % 2 == 0]]
+        for alive in subsets:
+            for k in range(1, len(alive) + 1):
+                first = hostmesh.select_survivors(alive, k, n)
+                again = hostmesh.select_survivors(list(reversed(alive)), k, n)
+                assert first == again, (n, alive, k)
+                survivors, rejected = first
+                assert len(survivors) == k
+                assert survivors == sorted(survivors)
+                assert rejected == sorted(rejected)
+                assert sorted(survivors + rejected) == sorted(alive)
+                if alive == full:
+                    assert hostmesh.is_contiguous_arc(survivors, n), \
+                        (n, k, survivors)
+
+
+def test_survivor_selection_pinned_cases():
+    # Full ring: smallest start offset wins the tie -> the low arc.
+    assert hostmesh.select_survivors([0, 1, 2, 3], 2, 4) == ([0, 1], [2, 3])
+    # Host 0 gone: the contiguous pair among the survivors wins.
+    assert hostmesh.select_survivors([0, 2, 3], 2, 4) == ([2, 3], [0])
+    # Host 3 gone: arc {1,2} beats the bisected {0,2}.
+    assert hostmesh.select_survivors([1, 2, 3], 2, 4) == ([1, 2], [3])
+    # k >= live: everyone survives, nothing rejected.
+    assert hostmesh.select_survivors([1, 3], 2, 4) == ([1, 3], [])
+    assert hostmesh.select_survivors([1, 3], 5, 4) == ([1, 3], [])
+    # k <= 0: degenerate, everyone rejected.
+    assert hostmesh.select_survivors([0, 1], 0, 4) == ([], [0, 1])
+
+
+def test_survivor_selection_wraps_around_the_ring():
+    # The best arc crosses the 0 boundary: {3, 0} on a 4-ring.
+    survivors, rejected = hostmesh.select_survivors([0, 1, 3], 2, 4)
+    assert (survivors, rejected) == ([0, 1], [3])  # tie -> smallest start
+    survivors, rejected = hostmesh.select_survivors([0, 3, 5], 2, 6)
+    assert (survivors, rejected) == ([0, 5], [3])  # arc {5,0} wraps
+    assert hostmesh.is_contiguous_arc([0, 5], 6)
+
+
+# ---------------------------------------------------------------------------
+# health.py: epoch namespace + reform barrier + membership markers
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_path_epoch_namespace(tmp_path):
+    d = str(tmp_path)
+    legacy = os.path.join(d, "heartbeat.3")
+    assert health.heartbeat_path(d, 3) == legacy
+    assert health.heartbeat_path(d, 3, epoch=0) == legacy
+    assert health.heartbeat_path(d, 3, epoch=None) == legacy
+    assert health.heartbeat_path(d, 3, epoch=2) == \
+        os.path.join(d, "heartbeat.e2.3")
+
+
+def test_reform_barrier_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert health.read_reform(d) is None
+    health.request_reform(d, epoch=2, trigger="host_drain", save=True)
+    barrier = health.read_reform(d)
+    assert barrier["epoch"] == 2 and barrier["trigger"] == "host_drain"
+    assert barrier["save"] is True
+    # A re-formed child must ignore the barrier that formed it (<= epoch).
+    assert health.read_reform(d, newer_than_epoch=2) is None
+    assert health.read_reform(d, newer_than_epoch=3) is None
+    assert health.read_reform(d, newer_than_epoch=1)["epoch"] == 2
+    health.clear_reform(d)
+    assert health.read_reform(d) is None
+    health.clear_reform(d)  # idempotent on an absent barrier
+
+
+def test_join_marker_carries_its_kind(tmp_path):
+    d = str(tmp_path)
+    assert health.consume_join(d) is None
+    health.announce_join(d)
+    assert health.consume_join(d) == "host_join"
+    assert health.consume_join(d) is None  # consumed exactly once
+    health.announce_rejoin(d)
+    assert health.consume_join(d) == "host_rejoin"
+    # The legacy boolean spelling still consumes either kind.
+    health.announce_join(d)
+    assert health.consume_rejoin(d) is True
+    assert health.consume_rejoin(d) is False
+
+
+def test_drain_markers_roundtrip(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    assert health.consume_drains(d) == []
+    health.announce_drain(d, host=2)
+    health.announce_drain(d, host=0)
+    assert health.consume_drains(d) == [0, 2]
+    assert health.consume_drains(d) == []
+    # Default host identity: DDL_ELASTIC_HOST (the ORIGINAL id) wins over
+    # DDL_PROCESS_ID (the slot of the current attempt).
+    monkeypatch.setenv("DDL_PROCESS_ID", "1")
+    monkeypatch.setenv(health.ENV_ELASTIC_HOST, "5")
+    health.announce_drain(d)
+    assert health.consume_drains(d) == [5]
+    monkeypatch.delenv(health.ENV_ELASTIC_HOST)
+    health.announce_drain(d)
+    assert health.consume_drains(d) == [1]
+
+
+def test_poll_drain_filters_own_epoch(tmp_path, monkeypatch):
+    monkeypatch.delenv(health.ENV_HEARTBEAT_DIR, raising=False)
+    assert health.poll_drain() is None  # unarmed outside a launcher
+    d = str(tmp_path)
+    monkeypatch.setenv(health.ENV_HEARTBEAT_DIR, d)
+    assert health.poll_drain() is None  # no barrier yet
+    health.request_reform(d, epoch=1, trigger="host_join", save=True)
+    monkeypatch.setenv(health.ENV_ELASTIC_EPOCH, "1")
+    assert health.poll_drain() is None  # the barrier that formed us
+    monkeypatch.setenv(health.ENV_ELASTIC_EPOCH, "0")
+    assert health.poll_drain()["trigger"] == "host_join"
+
+
+def test_heartbeat_writer_and_staleness_are_epoch_scoped(tmp_path,
+                                                         monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv(health.ENV_HEARTBEAT_DIR, d)
+    monkeypatch.setenv("DDL_PROCESS_ID", "1")
+    monkeypatch.setenv(health.ENV_ELASTIC_EPOCH, "3")
+    writer = health.HeartbeatWriter.from_env()
+    assert writer.path == os.path.join(d, "heartbeat.e3.1")
+    writer.beat(step=7)
+    old = 1_000_000.0
+    os.utime(writer.path, (old, old))
+    # The epoch-3 watchdog sees the stale beat; the legacy namespace and
+    # other epochs see nothing — a frozen file from a previous epoch can
+    # never trip the new epoch's staleness clock.
+    now = old + 100.0
+    assert [pid for pid, _ in
+            health.check_stale(d, 2, 30.0, now=now, epoch=3)] == [1]
+    assert health.check_stale(d, 2, 30.0, now=now) == []
+    assert health.check_stale(d, 2, 30.0, now=now, epoch=2) == []
+
+
+# ---------------------------------------------------------------------------
+# ElasticController: geometry table, epoch bump, topology-aware shrink
+# ---------------------------------------------------------------------------
+
+def test_controller_geometry_rewrites_the_full_mesh_shape(tmp_path):
+    hb = str(tmp_path)
+    base = ["python", "train.py", "--dp", "4", "--pp", "2",
+            "--optimizer-sharding", "zero2"]
+    ctl = launch.ElasticController(
+        2, hb, base_dp=4,
+        geometry={1: {"dp": 1, "pp": 4, "sharding": "none"}})
+    # Whole pod: no geometry entry for 2 hosts -> dp-only default.
+    assert ctl.degree == 4
+    assert ctl.command(base) == base
+    # Planned leave of host 0 -> 1 live host -> the geometry row applies:
+    # the re-formation crosses the pipeline AND ZeRO-stage axes.
+    health.announce_drain(hb, host=0)
+    assert ctl.poll_membership() == "host_drain"
+    assert ctl.has_pending and ctl.pending_trigger == "host_drain"
+    assert ctl.degree == 1
+    cmd = ctl.command(base)
+    assert cmd[cmd.index("--dp") + 1] == "1"
+    assert cmd[cmd.index("--pp") + 1] == "4"
+    assert cmd[cmd.index("--optimizer-sharding") + 1] == "none"
+
+
+def test_controller_epoch_bump_and_child_env(tmp_path):
+    hb = str(tmp_path)
+    ctl = launch.ElasticController(2, hb, base_dp=4)
+    health.announce_drain(hb, host=0)
+    assert ctl.poll_membership() == "host_drain"
+    event = ctl.take_reconfiguration()
+    assert event["trigger"] == "host_drain"
+    assert (event["degree_before"], event["degree_after"]) == (4, 2)
+    assert event["save"] is True          # every member alive -> collective
+    assert event["epoch"] == 1 and ctl.epoch == 1
+    env = ctl.child_env({})
+    assert list(env) == [0]               # one surviving slot
+    assert env[0][health.ENV_ELASTIC_EPOCH] == "1"
+    assert env[0][health.ENV_ELASTIC_HOST] == "1"  # original identity
+    exported = json.loads(env[0][health.ENV_ELASTIC_EVENT])
+    assert exported["epoch"] == 1 and exported["trigger"] == "host_drain"
+    # The event tags exactly one attempt; the next spawn is event-free.
+    assert health.ENV_ELASTIC_EVENT not in ctl.child_env({})[0]
+
+
+def test_controller_drain_respects_min_hosts_floor(tmp_path, capsys):
+    hb = str(tmp_path)
+    ctl = launch.ElasticController(2, hb, base_dp=4, min_hosts=2)
+    health.announce_drain(hb, host=1)
+    assert ctl.poll_membership() is None
+    assert not ctl.has_pending and ctl.live == [0, 1]
+    assert "drain of host 1 ignored" in capsys.readouterr().err
+
+
+def test_controller_host_lost_barrier_is_not_save_capable(tmp_path):
+    hb = str(tmp_path)
+    ctl = launch.ElasticController(2, hb, base_dp=4)
+    # Slot 1 beat once, then its heartbeat vanished with the host.
+    assert ctl.note_failure(1, -9, ever_beat=True) == "host_lost"
+    event = ctl.take_reconfiguration()
+    assert event["trigger"] == "host_lost"
+    assert event["save"] is False  # a collective save would wedge
+    assert event["epoch"] == 1
+
+
+def test_controller_topology_shrink_records_survivor_selection(tmp_path):
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    flight_dir = str(tmp_path / "flight")
+    try:
+        flightlib.configure(flight_dir, run_id="r", host=0)
+        # 4 hosts, but the geometry only knows shapes for 2 and 4: a
+        # single drain forces a shrink to the largest feasible count, and
+        # the survivor choice must keep the ICI ring contiguous.
+        ctl = launch.ElasticController(
+            4, hb, base_dp=8,
+            geometry={2: {"dp": 4, "sharding": "none"}})
+        health.announce_drain(hb, host=1)
+        assert ctl.poll_membership() == "host_drain"
+        assert ctl.live == [2, 3]  # the contiguous arc of {0, 2, 3}
+        assert ctl.degree == 4     # geometry row for 2 hosts
+        event = ctl.take_reconfiguration()
+        assert (event["degree_before"], event["degree_after"]) == (8, 4)
+        events, errors = flightlib.read_all(flight_dir)
+        assert errors == []
+        sel = [e for e in events if e["ev"] == "survivor_selection"]
+        assert len(sel) == 1
+        assert sel[0]["candidates"] == [0, 2, 3]
+        assert sel[0]["chosen"] == [2, 3]
+        assert sel[0]["rejected"] == [0]
+        assert sel[0]["contiguous"] is True
+    finally:
+        flightlib.reset()
+
+
+# ---------------------------------------------------------------------------
+# host_join / host_drain fault kinds (robustness/faults.py)
+# ---------------------------------------------------------------------------
+
+def test_parse_plan_rendezvous_kinds():
+    plan = faults.parse_plan("host_join@4,host_drain@6:a1")
+    assert [(f.kind, f.step) for f in plan] == [
+        ("host_join", 4), ("host_drain", 6)]
+    assert plan[0].attempt == 0 and plan[1].attempt == 1
+    with pytest.raises(ValueError):
+        faults.parse_plan("host_join@0")
+
+
+def test_injector_fires_join_and_drain_markers(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv(health.ENV_HEARTBEAT_DIR, d)
+    monkeypatch.setenv(health.ENV_ELASTIC_HOST, "2")
+    plan = faults.FaultPlan(faults.parse_plan("host_join@1,host_drain@2"))
+    fire = faults.make_injector(plan, ckpt=None, checkpoint_dir=None)
+    fire(1)
+    assert health.consume_join(d) == "host_join"
+    assert health.consume_drains(d) == []
+    fire(2)
+    assert health.consume_drains(d) == [2]  # original host identity
+    assert health.consume_join(d) is None
+    # Without a heartbeat dir both kinds degrade to a loud no-op.
+    monkeypatch.delenv(health.ENV_HEARTBEAT_DIR)
+    fire(1)
+    fire(2)
+
+
+# ---------------------------------------------------------------------------
+# Serve autoscale policy (launch.AutoscalePolicy)
+# ---------------------------------------------------------------------------
+
+def test_autoscale_ctor_validates_band():
+    with pytest.raises(ValueError):
+        launch.AutoscalePolicy(0, 2)
+    with pytest.raises(ValueError):
+        launch.AutoscalePolicy(3, 2)
+
+
+def test_autoscale_up_needs_sustained_backlog():
+    p = launch.AutoscalePolicy(1, 3, up_backlog_per_replica=2.0,
+                               up_sustain_polls=3)
+    # A two-poll burst is absorbed; the streak resets on the quiet poll.
+    assert p.decide(queue_depth=9, live_replicas=1) == 0
+    assert p.decide(queue_depth=9, live_replicas=1) == 0
+    assert p.decide(queue_depth=1, live_replicas=1) == 0
+    assert p.decide(queue_depth=9, live_replicas=1) == 0
+    assert p.decide(queue_depth=9, live_replicas=1) == 0
+    assert p.decide(queue_depth=9, live_replicas=1) == 1
+    # The decision zeroed the streak: the next event is a full window away.
+    assert p.decide(queue_depth=9, live_replicas=2) == 0
+    assert p.decide(queue_depth=9, live_replicas=2) == 0
+    assert p.decide(queue_depth=9, live_replicas=2) == 1
+
+
+def test_autoscale_threshold_scales_with_live_replicas():
+    p = launch.AutoscalePolicy(1, 4, up_backlog_per_replica=2.0,
+                               up_sustain_polls=1)
+    # 5 open requests over 3 replicas is under 2.0/replica: healthy.
+    assert p.decide(queue_depth=5, live_replicas=3) == 0
+    assert p.decide(queue_depth=7, live_replicas=3) == 1
+
+
+def test_autoscale_clamps_to_band():
+    p = launch.AutoscalePolicy(1, 2, up_sustain_polls=1, down_idle_polls=2)
+    assert p.decide(queue_depth=99, live_replicas=2) == 0  # at max
+    assert p.decide(queue_depth=0, live_replicas=1) == 0
+    assert p.decide(queue_depth=0, live_replicas=1) == 0   # at min
+    # The idle streak keeps counting while clamped at min, so the drain
+    # fires the moment capacity rises above the floor again.
+    assert p.decide(queue_depth=0, live_replicas=2) == -1
+
+
+def test_autoscale_down_needs_sustained_idle():
+    p = launch.AutoscalePolicy(1, 3, down_idle_polls=3)
+    assert p.decide(queue_depth=0, live_replicas=2) == 0
+    assert p.decide(queue_depth=0, live_replicas=2) == 0
+    assert p.decide(queue_depth=1, live_replicas=2) == 0  # traffic resets
+    assert p.decide(queue_depth=0, live_replicas=2) == 0
+    assert p.decide(queue_depth=0, live_replicas=2) == 0
+    assert p.decide(queue_depth=0, live_replicas=2) == -1
+
+
+# ---------------------------------------------------------------------------
+# elastic_reconfig storm anomaly (observability/anomaly.py)
+# ---------------------------------------------------------------------------
+
+def test_elastic_storm_fires_only_on_churn():
+    det = anomaly.AnomalyDetector()
+    # Three planned re-formations inside the window: normal, stays quiet.
+    assert det.update_elastic(0.0, epoch=1) == []
+    assert det.update_elastic(100.0, epoch=2) == []
+    assert det.update_elastic(200.0, epoch=3) == []
+    out = det.update_elastic(300.0, epoch=4)  # 4th inside 600 s: flapping
+    assert len(out) == 1 and out[0]["kind"] == "elastic_reconfig"
+    assert out[0]["step"] == 4 and out[0]["value"] == 4.0
+    assert "flapping" in out[0]["detail"]
+
+
+def test_elastic_storm_stays_quiet_when_spaced_out():
+    det = anomaly.AnomalyDetector()
+    for i, t in enumerate((0.0, 700.0, 1400.0, 2100.0, 2800.0)):
+        assert det.update_elastic(t, epoch=i + 1) == []
+    assert det.update_elastic(None) == []  # malformed clock: ignored

@@ -350,9 +350,13 @@ fi
 
 # 12b. Elastic re-formation soak (gated, OFF by default, same reasoning as
 # the chaos step: CPU-only, ask with DDL_ELASTIC=1). A 2-host dp4
-# transformer job loses a host (host_lost), auto-shrinks to dp2, grows
-# back to dp4 on rejoin, and records the measured reconfiguration_time_s
-# (fault detection -> first post-resume step; docs/fault_tolerance.md).
+# transformer job loses a host (host_lost), auto-shrinks to dp2 through
+# the rendezvous reform barrier (survivors drain voluntarily at a step
+# boundary — exit 75, no teardown — and re-form under a bumped membership
+# epoch), grows back to dp4 on rejoin, and records the measured
+# reconfiguration_time_s with its detect->drain->restore->compile->
+# first-step phase split (docs/fault_tolerance.md "Rendezvous
+# membership").
 if [ "${DDL_ELASTIC:-0}" = "1" ]; then
   check_stop elastic
   timeout 900 env JAX_PLATFORMS=cpu python bench.py --chaos-elastic \

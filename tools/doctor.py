@@ -270,17 +270,20 @@ def check_elastic() -> None:
     """Last elastic re-formation (loop.py drops
     .cache/last_elastic_event.json on process 0 when a run resumes under a
     launch.py --elastic membership event): trigger (host_lost / hung /
-    host_rejoin), degree before/after, the measured reconfiguration
-    seconds, and the resume step — so "what did the last re-formation
-    cost?" is answerable from doctor output. ok=True always: an absent
-    sidecar just means no elastic re-formation has happened yet."""
+    host_rejoin / host_join / host_drain), degree before/after, the
+    membership epoch it re-formed into, the measured reconfiguration
+    seconds with its detect->drain->restore->compile->first-step phase
+    split, and the resume step — so "what did the last re-formation
+    cost, and where did the time go?" is answerable from doctor output.
+    ok=True always: an absent sidecar just means no elastic
+    re-formation has happened yet."""
     from distributeddeeplearning_tpu.observability import sidecars
     side = sidecars.read("last_elastic_event")
     if side is not None:
         emit("elastic", ok=True,
              **{k: side.get(k) for k in (
-                 "trigger", "degree_before", "degree_after",
-                 "reconfiguration_time_s", "resume_step")})
+                 "trigger", "degree_before", "degree_after", "epoch",
+                 "reconfiguration_time_s", "phases", "resume_step")})
     else:
         emit("elastic", ok=True, last_event=None,
              note="no elastic sidecar; written when a launch.py --elastic "

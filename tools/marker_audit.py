@@ -92,7 +92,14 @@ def audit_elastic(records) -> list[str]:
     from the selection, or every one of them is also marked ``slow`` and
     tier-1's ``-m 'not slow'`` filters elastic coverage out entirely (the
     soak is legitimately slow — but a FAST variant must survive in
-    tier-1; tests/test_elastic_resume.py keeps one)."""
+    tier-1; tests/test_elastic_resume.py keeps one).
+
+    The rendezvous extension adds two coverage requirements: the
+    topology-aware survivor-selection unit grid must run in EVERY
+    selection (it is fast — losing it silently un-pins the deterministic
+    shrink choice), and when the selection includes slow tests at all,
+    the cross-axis soak (ZeRO stage + pipeline degree changing mid-run)
+    must be among them."""
     problems = []
     elastic = [r for r in records if r.get("elastic")]
     if not elastic:
@@ -105,6 +112,20 @@ def audit_elastic(records) -> list[str]:
             "every elastic-marked test is also marked slow — tier-1 runs "
             "-m 'not slow', so the cross-degree resume path is silently "
             "untested in tier-1 (keep a fast elastic variant unmarked)")
+    if not any("survivor" in (r.get("nodeid") or "") for r in elastic):
+        problems.append(
+            "no elastic-marked survivor-selection test ran — the "
+            "topology-aware shrink (hostmesh.select_survivors: "
+            "deterministic, ring-contiguous) is un-pinned in this run "
+            "(tests/test_rendezvous.py missing, renamed, or deselected?)")
+    if (any(r.get("slow") for r in records)
+            and not any("cross_axis" in (r.get("nodeid") or "")
+                        for r in elastic)):
+        problems.append(
+            "slow tests ran but no elastic-marked cross_axis soak did — "
+            "re-formation across the ZeRO-stage + pipeline-degree axes is "
+            "untested in this slow run (tests/test_elastic_resume.py "
+            "cross_axis soak missing, renamed, or deselected?)")
     return problems
 
 

@@ -30,6 +30,13 @@ cannot quietly regress it:
   a ``record(...)`` first. The clone changes which physical page a
   slot's writes land in; a replica killed mid-copy with no record of
   it leaves a page table a post-mortem cannot trust.
+- ``serve-span-registered``: every telemetry emission whose literal
+  name starts with ``serve:`` (span / instant / record_span / flow /
+  async begin+end) must use a name registered in
+  ``serve/tracing.REGISTERED_PHASES``. The serve trace schema
+  (docs/serve_tracing.md) is what tools/trace_report.py and the
+  attribution tests key on — an unregistered name is a span the whole
+  reporting stack silently ignores.
 - ``axis-name-consistency``: string axis names at ``psum`` /
   ``psum_scatter`` / ``all_gather`` / ``pmean`` / ... call sites must be
   declared in ``parallel/mesh.py``'s ``MESH_AXES`` — a typo'd axis name
@@ -442,9 +449,46 @@ def check_axis_names(tree: ast.Module, path: str,
 # driver
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# serve-span-registered
+# ---------------------------------------------------------------------------
+
+_SERVE_EMITTERS = {"span", "instant", "record_span", "flow",
+                   "async_begin", "async_end"}
+
+
+def check_serve_span_registry(tree: ast.Module, path: str) -> list[dict]:
+    """Every literal ``serve:*`` name at a telemetry emission site must
+    be registered in ``serve/tracing.REGISTERED_PHASES`` — the schema
+    the serve trace tooling (trace_report, attribution tests, docs) keys
+    on. tracing.py is pure stdlib, so importing the registry here keeps
+    the lint and the runtime schema one source of truth."""
+    from distributeddeeplearning_tpu.serve.tracing import REGISTERED_PHASES
+
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) in _SERVE_EMITTERS
+                and node.args):
+            continue
+        name = _const_str(node.args[0])
+        if name is None or not name.startswith("serve:"):
+            continue
+        if name not in REGISTERED_PHASES:
+            findings.append(finding(
+                "lints", "serve-span-registered",
+                f"serve trace name {name!r} is not in "
+                f"serve/tracing.REGISTERED_PHASES — register it (and "
+                f"document it in docs/serve_tracing.md) or the serve "
+                f"reporting stack silently ignores this event",
+                file=path, line=node.lineno))
+    return findings
+
+
 _CHECKS = (check_sidecar_writes, check_fsync_before_fire,
            check_unpaired_spans, check_perf_record_provenance,
-           check_page_table_log_before_dispatch, check_cow_before_write)
+           check_page_table_log_before_dispatch, check_cow_before_write,
+           check_serve_span_registry)
 
 
 def analyze_source(src: str, path: str = "<memory>", *,

@@ -872,15 +872,23 @@ def run_from_hostfile(path: str, process_id: int, command: Sequence[str], *,
 
 def _spawn_replica(replica: int, num_replicas: int, workdir: str, *,
                    attempt: int, heartbeat_dir: Optional[str],
-                   fault_plan: Optional[str]) -> subprocess.Popen:
+                   fault_plan: Optional[str],
+                   trace_dir: Optional[str] = None) -> subprocess.Popen:
     """One serve replica process. Heartbeat/flight identity reuse the
     training child conventions (``DDL_PROCESS_ID`` names both files); no
     coordinator is exported — replicas are independent model copies, not
-    ranks of one mesh."""
+    ranks of one mesh. ``trace_dir`` arms per-request tracing in the
+    child (``DDL_TRACE_DIR``) — set per spawn, never on the supervisor's
+    own environ, so a traced serve run cannot leak tracing into later
+    untraced children."""
     env = dict(os.environ)
     env[ENV_PROCESS_ID] = str(replica)
     env[ENV_NUM_PROCESSES] = str(num_replicas)
     env.pop(ENV_COORDINATOR, None)
+    if trace_dir is not None:
+        env[telemetry.ENV_TRACE_DIR] = trace_dir
+    else:
+        env.pop(telemetry.ENV_TRACE_DIR, None)
     # Serve replicas are outside the training membership: a stale elastic
     # epoch/identity inherited from a training launcher would namespace
     # their heartbeats away from the supervisor's staleness check.
@@ -988,6 +996,7 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
               poll_interval_s: float = 0.05,
               timeout_s: float = 600.0,
               autoscale: Optional[AutoscalePolicy] = None,
+              trace_dir: Optional[str] = None,
               clock: Callable[[], float] = time.monotonic) -> dict:
     """Supervise N serve-engine replicas over one request trace.
 
@@ -1017,6 +1026,14 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     same ``config.json``, so the fingerprint matches and the new replica
     skips compilation); scale-down routes through the stop-sentinel drain
     gate, so a scaled-down replica still runs the shutdown leak check.
+
+    With ``trace_dir``, every replica records per-request span trees
+    (``serve/tracing.py``) into ``trace.p<rid>.json`` there, the
+    supervisor records its dispatch/redispatch/replica-lost instants into
+    its own per-process file, and after the drain everything is merged
+    into ``trace_dir/trace.merged.json`` (``out["merged_trace"]``) — one
+    Chrome trace where a re-dispatched request's spans are flow-linked
+    across the replica processes it lived on.
     """
     if num_replicas < 1:
         raise ValueError(f"num_replicas={num_replicas}: need >= 1")
@@ -1042,6 +1059,16 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     flight.record("serve_launch", num_replicas=num_replicas,
                   requests=len(requests), max_restarts=max_restarts)
 
+    # Supervisor-side tracing: its OWN registry (never the module
+    # singleton — a bench tracing an in-process engine in this same
+    # process must not be clobbered), on a pid far above any replica id.
+    sup_tele = None
+    if trace_dir is not None:
+        os.makedirs(trace_dir, exist_ok=True)
+        sup_tele = telemetry.Telemetry(
+            enabled=True, trace_dir=trace_dir, process_index=10_000,
+            process_name="serve-supervisor")
+
     plans = dict(child_fault_plans or {})
     for plan in plans.values():
         faults.parse_plan(plan)  # fail fast on grammar errors
@@ -1063,7 +1090,8 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
     for i in range(num_replicas):
         proc = _spawn_replica(i, num_replicas, workdir, attempt=0,
                               heartbeat_dir=heartbeat_dir,
-                              fault_plan=plans.get(i))
+                              fault_plan=plans.get(i),
+                              trace_dir=trace_dir)
         reps.append({"proc": proc, "alive": True, "attempt": 0,
                      "restarts": 0, "ever_beat": False, "hung": False,
                      "last_step": 0, "offset": 0, "rc": None,
@@ -1146,6 +1174,10 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
         flight.record("serve_replica_lost", replica=rid, rc=rc,
                       step=rep["last_step"], attribution=label,
                       inflight=len(victims))
+        if sup_tele is not None:
+            sup_tele.instant("serve:replica_lost", replica=rid, rc=rc,
+                             step=rep["last_step"], attribution=label,
+                             inflight=len(victims))
         print(f"# launcher: serve replica {rid} lost at engine step "
               f"{rep['last_step']} (rc={rc}, {label}); "
               f"{len(victims)} in-flight request(s) to re-dispatch",
@@ -1172,7 +1204,8 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                           scope="serve")
             rep["proc"] = _spawn_replica(
                 rid, num_replicas, workdir, attempt=rep["attempt"],
-                heartbeat_dir=heartbeat_dir, fault_plan=plans.get(rid))
+                heartbeat_dir=heartbeat_dir, fault_plan=plans.get(rid),
+                trace_dir=trace_dir)
             rep["alive"], rep["hung"], rep["rc"] = True, False, None
 
     try:
@@ -1194,7 +1227,13 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                                "prompt": st["prompt"],
                                "max_new_tokens": st["max_new"],
                                "prefix": list(st["tokens"]),
-                               "dispatch": st["dispatches"]}
+                               "dispatch": st["dispatches"],
+                               # Trace/flow id: the supervisor's GLOBAL
+                               # uid, stable across re-dispatches, so
+                               # every replica's spans for this request
+                               # share one flow.
+                               "trace": uid,
+                               "redispatch": bool(st["retries"])}
                     _dispatch_request(workdir, rid, rep["attempt"], payload)
                     st["replica"], st["dispatched"] = rid, True
                     st["dispatches"] += 1
@@ -1202,6 +1241,16 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                         flight.record("serve_redispatch", request=uid,
                                       to=rid, resumed_from=len(st["tokens"]),
                                       retries=st["retries"])
+                        if sup_tele is not None:
+                            sup_tele.instant("serve:redispatch",
+                                             request=uid, to=rid,
+                                             trace=uid,
+                                             resumed_from=len(st["tokens"]),
+                                             retries=st["retries"])
+                    elif sup_tele is not None:
+                        sup_tele.instant("serve:dispatch", request=uid,
+                                         to=rid, trace=uid,
+                                         dispatch=st["dispatches"] - 1)
             # Autoscaling: observe the gauges, then let the policy move
             # the replica count (elastic membership for independent
             # replicas — ROADMAP 1d).
@@ -1224,7 +1273,8 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
                     proc = _spawn_replica(
                         rid, rid + 1, workdir, attempt=0,
                         heartbeat_dir=heartbeat_dir,
-                        fault_plan=plans.get(rid))
+                        fault_plan=plans.get(rid),
+                        trace_dir=trace_dir)
                     reps.append({"proc": proc, "alive": True,
                                  "attempt": 0, "restarts": 0,
                                  "ever_beat": False, "hung": False,
@@ -1334,6 +1384,16 @@ def run_serve(num_replicas: int, requests: Sequence[dict],
            "restarts": total_restarts, "window_s": window_s,
            "leak_check_ok": leak_check_ok,
            "replica_rcs": {i: r["rc"] for i, r in enumerate(reps)}}
+    if trace_dir is not None:
+        if sup_tele is not None:
+            sup_tele.export()
+        merged, merge_errors = telemetry.merge_trace_dir(trace_dir)
+        out["trace_dir"] = trace_dir
+        out["merged_trace"] = merged
+        if merge_errors:
+            # Typically the SIGKILL'd replica's last file — report what
+            # was salvaged rather than pretending the merge was whole.
+            out["trace_merge_errors"] = merge_errors
     if autoscale is not None:
         out["autoscale"] = {"scale_ups": scale_ups,
                             "scale_downs": scale_downs,
@@ -1385,7 +1445,8 @@ def _main_serve(args, p) -> int:
                     heartbeat_timeout_s=args.heartbeat_timeout,
                     max_restarts=args.max_restarts,
                     child_fault_plans=plans, flight_dir=args.flight_dir,
-                    autoscale=autoscale)
+                    autoscale=autoscale,
+                    trace_dir=args.serve_trace_dir)
     if args.serve_out:
         with open(args.serve_out, "w", encoding="utf-8") as f:
             json.dump(out, f, indent=2, sort_keys=True, default=str)
@@ -1507,6 +1568,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="write the serve-mode result summary (per-request "
                         "tokens, re-dispatch/restart accounting, leak "
                         "check) to this JSON file")
+    p.add_argument("--serve-trace-dir", default=None,
+                   help="with --serve, record per-request span trees in "
+                        "every replica (serve/tracing.py) and merge the "
+                        "per-replica files into "
+                        "TRACE_DIR/trace.merged.json after the drain — "
+                        "one Chrome trace, flow-linked across replicas")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, after `--`")
     args = p.parse_args(argv)
@@ -1525,6 +1592,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _main_serve(args, p)
     if args.serve_autoscale:
         p.error("--serve-autoscale requires --serve")
+    if args.serve_trace_dir:
+        p.error("--serve-trace-dir requires --serve")
     if not command:
         p.error("no training command given (pass it after `--`)")
 

@@ -40,6 +40,20 @@ Serve-side signals (fed by the replica / bench on the same cadence via
                                          shift — every rejected token is
                                          wasted verify work)
 
+Attribution-fed serve signals (require the serve tracer —
+``serve/tracing.py`` — whose per-request TTFT decomposition supplies the
+interval means; quiet when tracing is off):
+
+    queue_wait_regression                mean pre-admission wait
+                                         (queue + interference) far above
+                                         its rolling median
+    allocation_stall                     completions spending real time
+                                         blocked on pages
+                                         (admission_stall component)
+    decode_stall                         the decode dispatch itself got
+                                         slower vs its rolling median
+                                         (contention, pool thrash)
+
 Design constraints, in order:
 
 1. **Zero false positives on a clean run.** Baselines are rolling
@@ -98,6 +112,12 @@ class AnomalyDetector:
                  spec_collapse_frac: float = 0.25,
                  spec_median_floor: float = 0.2,
                  spec_min_proposed: int = 4,
+                 queue_wait_factor: float = 4.0,
+                 queue_wait_floor_s: float = 0.05,
+                 alloc_stall_factor: float = 4.0,
+                 alloc_stall_floor_s: float = 0.02,
+                 decode_stall_factor: float = 3.0,
+                 decode_stall_floor_s: float = 0.005,
                  elastic_storm_min: int = 4,
                  elastic_storm_window_s: float = 600.0):
         self.min_samples = int(min_samples)
@@ -115,6 +135,12 @@ class AnomalyDetector:
         self.spec_collapse_frac = float(spec_collapse_frac)
         self.spec_median_floor = float(spec_median_floor)
         self.spec_min_proposed = int(spec_min_proposed)
+        self.queue_wait_factor = float(queue_wait_factor)
+        self.queue_wait_floor_s = float(queue_wait_floor_s)
+        self.alloc_stall_factor = float(alloc_stall_factor)
+        self.alloc_stall_floor_s = float(alloc_stall_floor_s)
+        self.decode_stall_factor = float(decode_stall_factor)
+        self.decode_stall_floor_s = float(decode_stall_floor_s)
         self.elastic_storm_min = int(elastic_storm_min)
         self.elastic_storm_window_s = float(elastic_storm_window_s)
         self._loss: deque = deque(maxlen=window)
@@ -122,6 +148,9 @@ class AnomalyDetector:
         self._eps: deque = deque(maxlen=window)
         self._queue: deque = deque(maxlen=window)
         self._accept: deque = deque(maxlen=window)
+        self._qwait: deque = deque(maxlen=window)
+        self._astall: deque = deque(maxlen=window)
+        self._dtick: deque = deque(maxlen=window)
         self._reforms: deque = deque(maxlen=max(window, 32))
         self._straggler_streak = 0
 
@@ -216,13 +245,21 @@ class AnomalyDetector:
     def update_serve(self, step: int, *, queue_depth: Any = None,
                      sheds: Any = None, deadline_misses: Any = None,
                      finished: Any = None, spec_proposed: Any = None,
-                     spec_accepted: Any = None) -> list[dict]:
+                     spec_accepted: Any = None,
+                     queue_wait_s: Any = None,
+                     alloc_stall_s: Any = None,
+                     decode_tick_s: Any = None) -> list[dict]:
         """Feed one serve-cadence observation; returns flagged anomalies.
 
         ``queue_depth`` is the instantaneous wait-queue length;
         ``sheds``/``deadline_misses``/``finished`` and
         ``spec_proposed``/``spec_accepted`` are counts *for this
         interval* (the caller diffs the engine's cumulative counters).
+        ``queue_wait_s``/``alloc_stall_s``/``decode_tick_s`` are the
+        serve tracer's interval means (``ServeTracer.interval_signals``):
+        mean pre-admission wait and admission stall per completion, mean
+        decode dispatch duration per step — None (the default, and what
+        an untraced engine supplies) keeps those detectors silent.
         Same zero-false-positive discipline as ``update()``: queue depth
         and spec acceptance judge against their own rolling medians
         behind absolute floors and ``min_samples``; the storm/rate kinds
@@ -290,6 +327,54 @@ class AnomalyDetector:
                              "target distribution; verify work is being "
                              "wasted")
                 self._accept.append(rate)
+
+        if queue_wait_s is not None:
+            w = _finite(queue_wait_s)
+            if w is not None:
+                if len(self._qwait) >= self.min_samples:
+                    med = median(self._qwait)
+                    limit = max(self.queue_wait_floor_s,
+                                self.queue_wait_factor * med)
+                    if w > limit:
+                        flag("queue_wait_regression", w, med,
+                             f"mean pre-admission wait {w * 1e3:.1f}ms "
+                             f"per completion vs rolling median "
+                             f"{med * 1e3:.1f}ms (limit "
+                             f"{limit * 1e3:.1f}ms) — requests are aging "
+                             "in the queue before any resource stall")
+                self._qwait.append(w)
+
+        if alloc_stall_s is not None:
+            s2 = _finite(alloc_stall_s)
+            if s2 is not None:
+                if len(self._astall) >= self.min_samples:
+                    med = median(self._astall)
+                    limit = max(self.alloc_stall_floor_s,
+                                self.alloc_stall_factor * med)
+                    if s2 > limit:
+                        flag("allocation_stall", s2, med,
+                             f"mean admission stall {s2 * 1e3:.1f}ms per "
+                             f"completion vs rolling median "
+                             f"{med * 1e3:.1f}ms (limit "
+                             f"{limit * 1e3:.1f}ms) — the page pool is "
+                             "the bottleneck, not scheduling policy")
+                self._astall.append(s2)
+
+        if decode_tick_s is not None:
+            dt = _finite(decode_tick_s)
+            if dt is not None:
+                if len(self._dtick) >= self.min_samples:
+                    med = median(self._dtick)
+                    limit = max(self.decode_stall_floor_s,
+                                self.decode_stall_factor * med)
+                    if dt > limit:
+                        flag("decode_stall", dt, med,
+                             f"mean decode dispatch {dt * 1e3:.1f}ms vs "
+                             f"rolling median {med * 1e3:.1f}ms (limit "
+                             f"{limit * 1e3:.1f}ms) — the decode program "
+                             "itself slowed down (host contention, pool "
+                             "thrash), not admission")
+                self._dtick.append(dt)
 
         return out
 

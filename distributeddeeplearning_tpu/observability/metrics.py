@@ -39,7 +39,27 @@ from typing import Any, Optional
 
 DEFAULT_MAX_SAMPLES = 512
 
+#: Quantiles every observed series is summarized at — p99 is the SLO the
+#: serve stack is run against, p50/p90 give the shape of the body.
+PERCENTILES = (50, 90, 99)
+
 _RE_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def percentile(values, pct: float) -> Optional[float]:
+    """The ``pct``-th percentile of ``values`` by linear interpolation
+    between closest ranks (numpy's default method, stdlib-only so
+    jax-free readers can use it). None on an empty input."""
+    vals = sorted(v for v in values if _finite(v) is not None)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    rank = (len(vals) - 1) * (float(pct) / 100.0)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = rank - lo
+    return float(vals[lo] + (vals[hi] - vals[lo]) * frac)
 
 
 def _sane(name: str) -> str:
@@ -114,6 +134,7 @@ class MetricsRegistry:
             for name, per_host in sorted(by_name.items()):
                 vals = list(per_host.values())
                 series = list(self._series.get(name, ()))
+                svals = [v for (_, v) in series]
                 out["metrics"][name] = {
                     "last": vals[-1] if len(vals) == 1 else per_host[
                         sorted(per_host, key=str)[0]],
@@ -125,6 +146,12 @@ class MetricsRegistry:
                     "mean": sum(vals) / len(vals),
                     "samples": len(series),
                     "series_tail": series[-32:],
+                    # Quantiles over the OBSERVED SERIES (all samples in
+                    # the window), not the per-host latest values — for
+                    # per-request observations like serve_ttft_s these
+                    # ARE the p50/p90/p99 an SLO is stated against.
+                    "percentiles": {f"p{p}": percentile(svals, p)
+                                    for p in PERCENTILES},
                 }
             return out
 
@@ -143,6 +170,18 @@ class MetricsRegistry:
                                       key=lambda kv: str(kv[0])):
                     labels = f'run="{self.run_id}",host="{host}"'
                     lines.append(f"{metric}{{{labels}}} {v:.10g}")
+                svals = [v for (_, v) in self._series.get(name, ())]
+                if len(svals) > 1:
+                    # Series quantiles as separate gauge families (the
+                    # summary type would claim these are streaming
+                    # quantiles; they are window quantiles over the
+                    # bounded sample deque).
+                    for p in PERCENTILES:
+                        q = percentile(svals, p)
+                        qm = f"{metric}_p{p}"
+                        lines.append(f"# TYPE {qm} gauge")
+                        lines.append(
+                            f'{qm}{{run="{self.run_id}"}} {q:.10g}')
             return "\n".join(lines) + ("\n" if lines else "")
 
     # -- export ----------------------------------------------------------

@@ -18,7 +18,7 @@ from __future__ import annotations
 import sys
 from typing import Optional
 
-from distributeddeeplearning_tpu.observability import telemetry
+from distributeddeeplearning_tpu.observability import metrics, telemetry
 
 
 class StragglerMonitor:
@@ -28,6 +28,18 @@ class StragglerMonitor:
     def __init__(self, threshold: float, num_processes: int):
         self.threshold = float(threshold)
         self.num_processes = num_processes
+
+    def _warn(self, name: str, *, step: int, host: int,
+              chief: bool, message: str, **args) -> None:
+        """One path for every straggler verdict: a telemetry instant (so
+        the warning lands on the merged timeline next to the step spans
+        it explains) plus a chief-only stderr line. The skew *ratios*
+        are observed into the metrics registry unconditionally in
+        ``collect`` — trend tooling sees skew building before it crosses
+        the threshold's step function; this path fires only past it."""
+        telemetry.get().instant(name, step=step, host=host, **args)
+        if chief:
+            print(f"# {message}", file=sys.stderr, flush=True)
 
     def collect(self, step: int, step_time_s: float,
                 data_wait_s: float,
@@ -64,38 +76,44 @@ class StragglerMonitor:
             "host_step_time_mean": round(mean, 6),
             "host_data_wait_max": round(float(dw.max()), 6),
         }
+        chief = jax.process_index() == 0
         if compile_s is not None:
             cp = arr[:, 2]
             cmean = float(cp.mean())
             record["host_compile_min"] = round(float(cp.min()), 6)
             record["host_compile_max"] = round(float(cp.max()), 6)
             record["host_compile_mean"] = round(cmean, 6)
+            slow_cp = int(cp.argmax())
+            cratio = float(cp.max()) / cmean if cmean > 0 else 1.0
+            metrics.get().observe("straggler_compile_ratio", cratio,
+                                  step=step, host=slow_cp)
             # Compile skew matters above noise level only: sub-second
             # "compiles" are warm AOT loads everywhere.
-            if cmean > 0.5 and float(cp.max()) > self.threshold * cmean:
-                slow_cp = int(cp.argmax())
+            if cmean > 0.5 and cratio > self.threshold:
                 record["compile_straggler_host"] = slow_cp
-                telemetry.get().instant(
+                self._warn(
                     "compile_straggler", step=step, host=slow_cp,
+                    chief=chief,
                     compile_s=round(float(cp.max()), 6),
-                    mean_s=round(cmean, 6))
-                if jax.process_index() == 0:
-                    print(f"# compile straggler: host {slow_cp} compiled in "
-                          f"{cp.max():.1f}s > {self.threshold:.2f}x mean "
-                          f"{cmean:.1f}s (cold cache on one host?)",
-                          file=sys.stderr, flush=True)
-        if mean > 0 and float(st.max()) > self.threshold * mean:
+                    mean_s=round(cmean, 6),
+                    message=(f"compile straggler: host {slow_cp} compiled "
+                             f"in {cp.max():.1f}s > {self.threshold:.2f}x "
+                             f"mean {cmean:.1f}s (cold cache on one "
+                             f"host?)"))
+        ratio = float(st.max()) / mean if mean > 0 else 1.0
+        metrics.get().observe("straggler_step_time_ratio", ratio,
+                              step=step, host=slowest)
+        if mean > 0 and ratio > self.threshold:
             record["straggler_host"] = slowest
-            telemetry.get().instant(
-                "straggler", step=step, host=slowest,
+            self._warn(
+                "straggler", step=step, host=slowest, chief=chief,
                 step_time_s=round(float(st.max()), 6),
-                mean_s=round(mean, 6))
-            if jax.process_index() == 0:
-                print(f"# straggler: host {slowest} step_time "
-                      f"{st.max():.4f}s > {self.threshold:.2f}x mean "
-                      f"{mean:.4f}s at step {step} "
-                      f"(data_wait {dw[slowest]:.4f}s)",
-                      file=sys.stderr, flush=True)
+                mean_s=round(mean, 6),
+                data_wait_s=round(float(dw[slowest]), 6),
+                message=(f"straggler: host {slowest} step_time "
+                         f"{st.max():.4f}s > {self.threshold:.2f}x mean "
+                         f"{mean:.4f}s at step {step} "
+                         f"(data_wait {dw[slowest]:.4f}s)"))
         return record
 
 

@@ -34,6 +34,7 @@ instrumentation sites use; tests construct :class:`Telemetry` directly.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import threading
@@ -41,7 +42,17 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+try:  # POSIX advisory locking for multi-process export merges
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX fallback
+    fcntl = None  # type: ignore[assignment]
+
 DEFAULT_MAX_EVENTS = 200_000
+
+#: Child processes (serve replicas) inherit their trace destination from
+#: the supervisor through this env var — the serve counterpart of the
+#: launcher's coordinator env plumbing.
+ENV_TRACE_DIR = "DDL_TRACE_DIR"
 
 
 def now_s() -> float:
@@ -138,12 +149,16 @@ class Telemetry:
         return _Span(self, name, args)
 
     def record_span(self, name: str, start_s: float, end_s: float, *,
-                    step: Optional[int] = None, **args: Any) -> None:
+                    step: Optional[int] = None, tid: Optional[int] = None,
+                    **args: Any) -> None:
         """Record an already-measured span from two :func:`now_s` readings
         — for call sites that time unconditionally (the hot loop shares one
         clock read between telemetry and the straggler monitor) or that
         only decide to record after the fact (checkpoint_save records only
-        when a save actually launched)."""
+        when a save actually launched). ``tid`` overrides the thread-id
+        lane: the serve engine renders per-slot decode ticks on one stable
+        track per slot instead of interleaving every slot onto the host
+        thread's row."""
         if not self.enabled or not self._in_window(step):
             return
         if step is not None:
@@ -152,7 +167,57 @@ class Telemetry:
             "name": name, "ph": "X", "ts": int(start_s * 1e6),
             "dur": max(int((end_s - start_s) * 1e6), 0),
             "pid": self.process_index,
-            "tid": threading.get_ident() & 0xFFFF, "args": args})
+            "tid": (threading.get_ident() & 0xFFFF if tid is None
+                    else int(tid)),
+            "args": args})
+
+    def flow(self, name: str, flow_id: int, phase: str, *,
+             ts_s: Optional[float] = None, cat: str = "serve",
+             **args: Any) -> None:
+        """A flow event: ``phase`` is ``"s"`` (start), ``"t"`` (step), or
+        ``"f"`` (finish). Events sharing ``cat`` + ``flow_id`` draw one
+        arrow chain in the trace viewer ACROSS processes — how a request
+        re-dispatched after a replica death stays one visual thread. Flow
+        events bind to the enclosing slice on their pid/tid/ts, so stamp
+        ``ts_s`` inside the span the arrow should anchor to."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name, "cat": cat, "ph": phase, "id": int(flow_id),
+            "ts": (time.monotonic_ns() // 1000 if ts_s is None
+                   else int(ts_s * 1e6)),
+            "pid": self.process_index,
+            "tid": threading.get_ident() & 0xFFFF, "args": args}
+        if phase == "f":
+            event["bp"] = "e"  # bind the finish to the enclosing slice
+        self._emit(event)
+
+    def async_begin(self, name: str, async_id: int, *,
+                    ts_s: Optional[float] = None, cat: str = "serve",
+                    **args: Any) -> None:
+        """Open an async ("b") span — a wall-clock track whose begin/end
+        can be in different steps (a request's whole life from arrival to
+        retirement). Matched to :meth:`async_end` by ``cat`` + id +
+        name."""
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "cat": cat, "ph": "b", "id": int(async_id),
+            "ts": (time.monotonic_ns() // 1000 if ts_s is None
+                   else int(ts_s * 1e6)),
+            "pid": self.process_index, "tid": 0, "args": args})
+
+    def async_end(self, name: str, async_id: int, *,
+                  ts_s: Optional[float] = None, cat: str = "serve",
+                  **args: Any) -> None:
+        """Close an async span opened by :meth:`async_begin`."""
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "cat": cat, "ph": "e", "id": int(async_id),
+            "ts": (time.monotonic_ns() // 1000 if ts_s is None
+                   else int(ts_s * 1e6)),
+            "pid": self.process_index, "tid": 0, "args": args})
 
     def instant(self, name: str, *, step: Optional[int] = None,
                 **args: Any) -> None:
@@ -215,28 +280,44 @@ class Telemetry:
             self._events.clear()
         if not events:
             return None
-        existing: list = []
-        try:
-            with open(path) as fh:
-                prior = json.load(fh)
-            existing = (prior.get("traceEvents", [])
-                        if isinstance(prior, dict) else list(prior))
-        except (OSError, ValueError):
-            pass  # first write, or an unreadable prior file: start fresh
-        meta = []
-        if not any(e.get("ph") == "M" and e.get("pid") == self.process_index
-                   for e in existing):
-            meta.append({
-                "name": "process_name", "ph": "M", "ts": 0,
-                "pid": self.process_index,
-                "args": {"name":
-                         f"{self.process_name} p{self.process_index}"}})
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump({"traceEvents": existing + meta + events,
-                       "displayTimeUnit": "ms"}, fh)
-        os.replace(tmp, path)
+        # The merge below is read-modify-write; two processes (or threads
+        # of one process through separate registries) exporting to the
+        # same path would otherwise race and lose whichever write landed
+        # first. Serialize through an advisory lock on a sidecar file —
+        # the trace itself is still replaced atomically, so readers never
+        # need the lock.
+        lock_fh = None
+        if fcntl is not None:
+            lock_fh = open(f"{path}.lock", "a")
+            fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+        try:
+            existing: list = []
+            try:
+                with open(path) as fh:
+                    prior = json.load(fh)
+                existing = (prior.get("traceEvents", [])
+                            if isinstance(prior, dict) else list(prior))
+            except (OSError, ValueError):
+                pass  # first write, or an unreadable prior file
+            meta = []
+            if not any(e.get("ph") == "M"
+                       and e.get("pid") == self.process_index
+                       for e in existing):
+                meta.append({
+                    "name": "process_name", "ph": "M", "ts": 0,
+                    "pid": self.process_index,
+                    "args": {"name":
+                             f"{self.process_name} p{self.process_index}"}})
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "w") as fh:
+                json.dump({"traceEvents": existing + meta + events,
+                           "displayTimeUnit": "ms"}, fh)
+            os.replace(tmp, path)
+        finally:
+            if lock_fh is not None:
+                fcntl.flock(lock_fh.fileno(), fcntl.LOCK_UN)
+                lock_fh.close()
         return path
 
 
@@ -267,6 +348,20 @@ def configure(enabled: Optional[bool] = None,
                         process_index=process_index,
                         process_name=process_name)
     return _active
+
+
+def configure_from_env(process_index: int = 0,
+                       process_name: str = "ddl") -> Optional[Telemetry]:
+    """Child-process side of the serve trace plumbing: install a registry
+    pointed at :data:`ENV_TRACE_DIR` when the supervisor set it, else
+    leave the (disabled) singleton alone and return None. Replicas call
+    this before building their engine so the engine's tracer resolves."""
+    trace_dir = os.environ.get(ENV_TRACE_DIR)
+    if not trace_dir:
+        return None
+    return configure(enabled=True, trace_dir=trace_dir,
+                     process_index=process_index,
+                     process_name=process_name)
 
 
 def reset() -> None:
@@ -333,6 +428,48 @@ def load_events_tolerant(path: str) -> tuple[list[dict], Optional[str]]:
         i = end
     return events, (f"{path}: truncated trace; recovered "
                     f"{len(events)} complete event(s)")
+
+
+def merge_traces(paths, out_path: str) -> tuple[Optional[str], list[str]]:
+    """Fold several per-process trace files into ONE Chrome-trace JSON.
+
+    Every timestamp is CLOCK_MONOTONIC on the one host the serve fleet
+    runs on, so a plain concatenation is already time-coherent; events
+    are sorted metadata-first then by timestamp so viewers name the
+    process tracks before drawing them. Damaged inputs (a SIGKILL'd
+    replica's final file) go through the tolerant loader — whatever was
+    recovered is merged and the loss is reported, not hidden. Returns
+    ``(out_path or None-if-no-events, errors)``.
+    """
+    events: list[dict] = []
+    errors: list[str] = []
+    for p in paths:
+        evs, err = load_events_tolerant(p)
+        events.extend(evs)
+        if err:
+            errors.append(err)
+    if not events:
+        return None, errors
+    events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0)))
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    os.replace(tmp, out_path)
+    return out_path, errors
+
+
+def merge_trace_dir(trace_dir: str, out_name: str = "trace.merged.json"
+                    ) -> tuple[Optional[str], list[str]]:
+    """Merge every ``trace.p*.json`` in ``trace_dir`` (the per-replica
+    layout :func:`trace_path` writes) into ``trace_dir/out_name``. The
+    merged name deliberately does not match the per-process glob, so
+    directory-mode tools never double-count it."""
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace.p*.json")))
+    if not paths:
+        return None, [f"{trace_dir}: no trace.p*.json files to merge"]
+    return merge_traces(paths, os.path.join(trace_dir, out_name))
 
 
 def phase_totals(events) -> dict[str, dict[str, float]]:

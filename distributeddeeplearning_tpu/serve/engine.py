@@ -33,6 +33,10 @@ Observability: per-request lifecycle events (``serve_admit`` /
 ``serve_preempt`` / ``serve_shed`` / ``serve_deadline_miss``) go to the
 flight recorder; engine gauges (live slots, page occupancy, queue depth,
 TTFT, shed/deadline-miss/retry counters) to ``observability/metrics.py``.
+When telemetry is enabled at construction, ``serve/tracing.py`` adds
+request-scoped Chrome-trace span trees and exact TTFT/latency
+attribution (docs/serve_tracing.md); when it is not, the engine holds no
+tracer and the hot loop pays one ``is not None`` check per site.
 
 Failure modes (docs/serving.md "Failure modes and recovery"): the engine
 accepts a serve fault plan (``robustness/faults.py`` grammar, resolved
@@ -61,6 +65,7 @@ import numpy as np
 
 from distributeddeeplearning_tpu.robustness import faults as faultslib
 from distributeddeeplearning_tpu.serve import kv_cache
+from distributeddeeplearning_tpu.serve import tracing as tracinglib
 from distributeddeeplearning_tpu.serve.scheduler import (BrownoutController,
                                                          SloScheduler)
 
@@ -135,6 +140,9 @@ class Request:
     not_before_s: float = 0.0   # retry backoff: ineligible before this
     failed: Optional[str] = None  # "deadline"/"shed"/"retries_exhausted"
     _last_emit_s: Optional[float] = None
+    # tracing.RequestTrace when the engine was built with telemetry
+    # enabled; stays None (zero per-request overhead) otherwise.
+    trace: Any = None
 
     @property
     def total_tokens(self) -> int:
@@ -211,6 +219,11 @@ class Engine:
         self.config = cfg
         self.scheduler = scheduler or SloScheduler()
         self._clock = clock or time.monotonic
+        # Resolved ONCE: telemetry must be configured before the engine
+        # is built. None IS the disabled path — every instrumentation
+        # site below is behind a single ``is not None`` check and no
+        # per-request trace state is ever allocated (pinned by test).
+        self._tracer = tracinglib.maybe_tracer()
         if model is None:
             from distributeddeeplearning_tpu import models as modelslib
             model = modelslib.model_spec(cfg.model).build(
@@ -328,8 +341,16 @@ class Engine:
 
     def submit(self, prompt: Sequence[int], *, max_new_tokens: int,
                tenant: str = "default",
-               arrival_s: Optional[float] = None) -> Request:
-        """Queue one request; admission happens on a later ``step()``."""
+               arrival_s: Optional[float] = None,
+               trace_id: Optional[int] = None,
+               resumed: bool = False) -> Request:
+        """Queue one request; admission happens on a later ``step()``.
+
+        ``trace_id``/``resumed`` are tracing metadata: the supervisor
+        passes its GLOBAL uid as the trace id (engine uids are local) so
+        a re-dispatched request keeps one flow id across replicas, and
+        ``resumed=True`` marks a continuation of a flow another process
+        opened. Both are ignored when tracing is off."""
         from distributeddeeplearning_tpu.models import generate as genlib
 
         prompt = [int(t) for t in prompt]
@@ -357,7 +378,16 @@ class Engine:
                                  else arrival_s))
         self._uid += 1
         self.waiting.append(req)
+        if self._tracer is not None:
+            self._tracer.on_submit(req, trace_id, resumed=resumed)
         return req
+
+    @property
+    def tracer(self):
+        """The serve tracer (``serve/tracing.ServeTracer``), or None when
+        telemetry was disabled at construction — callers branch on this
+        for attribution-fed reporting (replica anomaly cadence, bench)."""
+        return self._tracer
 
     @property
     def num_live(self) -> int:
@@ -387,6 +417,12 @@ class Engine:
             self._stall(stall_s)
         now = self._clock()
         finished_before = len(self.finished)
+        tr = self._tracer
+        if tr is not None:
+            # Time since the previous step's end is queue time for
+            # everything still waiting (accrued BEFORE the shed pass so
+            # a shed request's attribution is complete at finalize).
+            tr.on_step_start(self.waiting, now)
         if self.brownout is not None:
             for req in self.brownout.plan_shed(
                     now=now, waiting=list(self.waiting),
@@ -395,6 +431,7 @@ class Engine:
                     num_pages=self.config.num_pages):
                 self.waiting.remove(req)
                 self._fail(req, "shed", now)
+        t_plan0 = self._clock() if tr is not None else 0.0
         plan = self.scheduler.plan(
             now=now, waiting=list(self.waiting), live=self._slot_views(),
             free_slots=self.config.max_slots - self.num_live,
@@ -402,6 +439,9 @@ class Engine:
             page_size=self.config.page_size,
             need_pages=(self._need_pages if self.prefix is not None
                         else None))
+        if tr is not None:
+            tr.on_plan(plan, t_plan0, self._clock(), step=self.steps,
+                       waiting=len(self.waiting))
         for slot in plan.cancel:
             self._cancel(slot, now)
         for req in plan.expire:
@@ -417,6 +457,11 @@ class Engine:
                 self._spec_decode_step()
             else:
                 self._decode_step()
+        if tr is not None:
+            # Classify this step's waiting time per request from the
+            # scheduler's non-admission reason (an allocator-race
+            # requeue in _admit overrides its own).
+            tr.on_step_end(self.waiting, plan, self._clock())
         self.steps += 1
         reg = metrics.get()
         reg.observe("serve_live_slots", self.num_live, step=self.steps)
@@ -428,6 +473,8 @@ class Engine:
         reg.observe("serve_deadline_miss_total", self.deadline_misses,
                     step=self.steps)
         reg.observe("serve_retry_total", self.retries, step=self.steps)
+        reg.observe("serve_alloc_failures", self.allocator.alloc_failures,
+                    step=self.steps)
         if self.prefix is not None:
             admits = self.prefix_hits + self.prefix_misses
             reg.observe("serve_prefix_hit_rate",
@@ -798,6 +845,12 @@ class Engine:
         from distributeddeeplearning_tpu.observability import flight
 
         cfg = self.config
+        tr = self._tracer
+        t_adm0 = self._clock() if tr is not None else 0.0
+        if tr is not None:
+            # Time from step start to here served OTHER requests
+            # (expire/preempt handling, earlier admissions' prefills).
+            tr.on_admit_start(req, t_adm0)
         slot = next(i for i, s in enumerate(self._slots) if s is None)
         ids = req.prefill_ids
         plen = len(ids)
@@ -832,6 +885,8 @@ class Engine:
             if cow_src is not None:
                 self.allocator.decref([cow_src])
             self.waiting.appendleft(req)
+            if tr is not None:
+                tr.on_requeue(req, self._clock(), step=self.steps)
             return
         pages = shared + new_pages
         self._admitted_seq += 1
@@ -851,10 +906,23 @@ class Engine:
                             tenant=req.tenant, slot=slot, pages=need_total,
                             new_pages=need_new, prefix_tokens=prefix_len,
                             resumed=bool(req.tokens))
+        if tr is not None:
+            tr.on_alloc(req, t_adm0, self._clock(), step=self.steps,
+                        slot=slot, new_pages=need_new,
+                        shared_pages=len(shared),
+                        prefix_tokens=prefix_len,
+                        prefix_cache=self.prefix is not None,
+                        cow=cow_src is not None)
         if cow_src is not None:
+            t_cow0 = self._clock() if tr is not None else 0.0
             self._run_page_copy(cow_src, pages[len(shared)])
             self.allocator.decref([cow_src])  # unpin the clone source
+            if tr is not None:
+                tr.on_cow_copy(req, t_cow0, self._clock(),
+                               step=self.steps, src=cow_src,
+                               dst=pages[len(shared)])
         n_suffix = plen - prefix_len
+        t_pf0 = self._clock() if tr is not None else 0.0
         if self.prefix is not None:
             self._assert_cow_writable(slot, prefix_len, n_suffix)
             bucket = self._bucket_for(n_suffix)
@@ -886,7 +954,16 @@ class Engine:
         flight.get().record("serve_prefill", request=req.uid, slot=slot,
                             bucket=bucket, prompt_tokens=plen)
         first = req.ttft_s is None
+        resumed = bool(req.tokens)  # read BEFORE emit appends
         req.emit(tok, now)
+        if tr is not None:
+            tr.on_prefill(req, t_pf0, now, step=self.steps, slot=slot,
+                          bucket=bucket,
+                          prefill_tokens=(n_suffix
+                                          if self.prefix is not None
+                                          else plen),
+                          prefix_tokens=prefix_len, first=first,
+                          resumed=resumed)
         if first:
             from distributeddeeplearning_tpu.observability import metrics
             metrics.get().observe("serve_ttft_s", req.ttft_s,
@@ -902,6 +979,8 @@ class Engine:
     def _decode_step(self) -> None:
         import jax.numpy as jnp
 
+        tr = self._tracer
+        t_d0 = self._clock() if tr is not None else 0.0
         for i in np.flatnonzero(self._live):
             self._assert_cow_writable(int(i), int(self._lengths[i]), 1)
         toks, pools = self._decode_program()(
@@ -911,6 +990,12 @@ class Engine:
         self._pools = pools
         toks = np.asarray(toks)
         now = self._clock()
+        if tr is not None:
+            # Accrues decode for every participant BEFORE the retire
+            # loop below finalizes any of them.
+            tr.on_decode(t_d0, now, step=self.steps,
+                         slots=[(int(i), self._slots[i].request)
+                                for i in np.flatnonzero(self._live)])
         for i in np.flatnonzero(self._live):
             req = self._slots[i].request
             req.emit(toks[i], now)
@@ -938,6 +1023,8 @@ class Engine:
         import jax.numpy as jnp
 
         cfg = self.config
+        tr = self._tracer
+        t_d0 = self._clock() if tr is not None else 0.0
         live_idx = [int(i) for i in np.flatnonzero(self._live)]
         L = self._lengths.copy()
         d = self._d_len.copy()
@@ -979,6 +1066,7 @@ class Engine:
                     if int(d[i]) >= int(L[i]):
                         proposals[i].append(int(toks[i]))
                     d[i] += 1
+        t_draft1 = self._clock() if tr is not None else 0.0
         block = np.zeros((cfg.max_slots, cfg.spec_k + 1), np.int32)
         n_new = np.zeros((cfg.max_slots,), np.int32)
         for i in live_idx:
@@ -994,6 +1082,13 @@ class Engine:
         greedy = np.asarray(greedy)
         now = self._clock()
         self.spec_rounds += 1
+        round_proposed = round_accepted = 0
+        if tr is not None:
+            tr.on_decode(t_d0, now, step=self.steps,
+                         slots=[(i, self._slots[i].request,
+                                 {"spec": True,
+                                  "proposed": int(n_prop[i])})
+                                for i in live_idx])
         for i in live_idx:
             req = self._slots[i].request
             n = int(n_prop[i])
@@ -1002,6 +1097,8 @@ class Engine:
                 m += 1
             self.spec_proposed += n
             self.spec_accepted += m
+            round_proposed += n
+            round_accepted += m
             for j in range(m + 1):
                 req.emit(int(greedy[i, j]), now)
             new_len = int(L[i]) + m + 1
@@ -1013,6 +1110,11 @@ class Engine:
             self._d_len[i] = min(int(d[i]), new_len)
             if req.remaining == 0:
                 self._retire(i, now)
+        if tr is not None:
+            tr.on_spec_phases(
+                t_d0, t_draft1, now, step=self.steps,
+                rounds=int(steps_needed.max()) if live_idx else 0,
+                proposed=round_proposed, accepted=round_accepted)
 
     def _retire(self, slot: int, now: float) -> None:
         from distributeddeeplearning_tpu.observability import flight
@@ -1030,6 +1132,8 @@ class Engine:
         flight.get().record("serve_retire", request=req.uid, slot=slot,
                             tokens=len(req.tokens),
                             preemptions=req.preemptions)
+        if self._tracer is not None:
+            self._tracer.finalize(req, now, status="ok")
 
     def _preempt(self, slot: int, now: float) -> None:
         from distributeddeeplearning_tpu.observability import flight
@@ -1045,6 +1149,8 @@ class Engine:
         flight.get().record("serve_preempt", request=req.uid, slot=slot,
                             tenant=req.tenant,
                             tokens_done=len(req.tokens))
+        if self._tracer is not None:
+            self._tracer.on_preempt(req, now, step=self.steps, slot=slot)
         # Bounded retry with exponential backoff: the scheduler owns the
         # policy, the engine applies it on every re-queue.
         req.retries += 1
@@ -1066,6 +1172,8 @@ class Engine:
         self.allocator.release(entry.pages)
         entry.pages = []
         self._clear_slot(slot)
+        if self._tracer is not None:
+            self._tracer.on_cancel(req, now)
         self._fail(req, "deadline", now)
 
     def _fail(self, req: Request, reason: str, now: float) -> None:
@@ -1085,6 +1193,8 @@ class Engine:
             flight.get().record("serve_shed", request=req.uid,
                                 tenant=req.tenant, reason=reason,
                                 tokens_done=len(req.tokens))
+        if self._tracer is not None:
+            self._tracer.on_fail(req, now, reason=reason)
 
     def _clear_slot(self, slot: int) -> None:
         self._slots[slot] = None

@@ -331,6 +331,12 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         self._free = list(range(self.num_pages - 1, -1, -1))
         self._ref: dict[int, int] = {}
+        # Running totals for the tracing/anomaly layer: how often the
+        # pool was asked, and how often it said no (an allocation-stall
+        # signal that scalar occupancy gauges cannot distinguish from
+        # healthy high utilization).
+        self.alloc_calls = 0
+        self.alloc_failures = 0
 
     @property
     def free_pages(self) -> int:
@@ -346,7 +352,9 @@ class PageAllocator:
         control's budget check."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        self.alloc_calls += 1
         if n > len(self._free):
+            self.alloc_failures += 1
             return None
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:

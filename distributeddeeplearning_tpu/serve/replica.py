@@ -57,7 +57,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     wd, rid = args.workdir, args.replica
 
-    from distributeddeeplearning_tpu.observability import flight, health
+    from distributeddeeplearning_tpu.observability import (anomaly, flight,
+                                                           health, telemetry)
     from distributeddeeplearning_tpu.robustness import faults
     from distributeddeeplearning_tpu.serve import engine as enginelib
 
@@ -72,6 +73,12 @@ def main(argv=None) -> int:
     cfg = enginelib.ServeConfig(**d)
 
     flight.configure_from_env(host=rid)
+    # Tracing destination rides DDL_TRACE_DIR from the supervisor; the
+    # replica id is the Chrome pid, so every replica gets its own named
+    # process track in the merged trace. Must happen BEFORE the engine
+    # is built — the engine resolves its tracer at construction.
+    tele = telemetry.configure_from_env(
+        process_index=rid, process_name=f"serve-replica-{rid}")
     attempt = faults.current_attempt()
     flight.get().record("serve_replica_start", replica=rid, attempt=attempt)
     hb = health.HeartbeatWriter.from_env()
@@ -111,10 +118,16 @@ def main(argv=None) -> int:
                 d = json.load(f)
             uid = int(d["uid"])
             prefix = [int(t) for t in (d.get("prefix") or [])]
+            # The supervisor's GLOBAL uid is the trace/flow id (engine
+            # uids are replica-local): a re-dispatched victim keeps ONE
+            # flow id across both replica processes, which is what links
+            # its spans in the merged trace.
             req = eng.submit(
                 [int(t) for t in d["prompt"]] + prefix,
                 max_new_tokens=int(d["max_new_tokens"]) - len(prefix),
-                tenant=d.get("tenant", "default"))
+                tenant=d.get("tenant", "default"),
+                trace_id=int(d.get("trace", uid)),
+                resumed=bool(d.get("redispatch")) or bool(prefix))
             reqs[uid], sent[uid] = req, 0
             _emit(ev, {"ev": "accepted", "uid": uid, "replica": rid,
                        "resumed_from": len(prefix)})
@@ -138,6 +151,13 @@ def main(argv=None) -> int:
                 _emit(ev, {"ev": "finished", "uid": uid, "step": eng.steps,
                            "tokens": n})
 
+    # Attribution-fed anomaly watch (queue-wait regression, allocation
+    # stall, decode stall) rides the same cadence as the trace export;
+    # both exist only when the supervisor asked for tracing, so an
+    # untraced replica's step loop is unchanged.
+    det = anomaly.AnomalyDetector() if tele is not None else None
+    det_last = (0, 0, 0, 0, 0)
+
     while True:
         pull_inbox()
         if eng.idle:
@@ -151,8 +171,35 @@ def main(argv=None) -> int:
         if hb is not None:
             hb.beat(eng.steps)
         report_progress()
+        if tele is not None:
+            # Export every step: the merge in telemetry.export is what
+            # makes a SIGKILL'd replica lose at most the dying step's
+            # spans — the pre-kill life of a later re-dispatched request
+            # survives into the merged trace.
+            tele.export()
+            if det is not None and eng.steps % 16 == 0:
+                cur = (eng.sheds, eng.deadline_misses, len(eng.finished),
+                       eng.spec_proposed, eng.spec_accepted)
+                diff = [c - p for c, p in zip(cur, det_last)]
+                det_last = cur
+                sig = (eng.tracer.interval_signals()
+                       if eng.tracer is not None else {})
+                anomaly.report(
+                    det.update_serve(
+                        eng.steps, queue_depth=len(eng.waiting),
+                        sheds=diff[0], deadline_misses=diff[1],
+                        finished=diff[2], spec_proposed=diff[3],
+                        spec_accepted=diff[4],
+                        queue_wait_s=sig.get("queue_wait_s"),
+                        alloc_stall_s=sig.get("alloc_stall_s"),
+                        decode_tick_s=sig.get("decode_tick_s")),
+                    flight_rec=flight.get(), tele=tele)
 
-    eng.shutdown()  # raises on a page leak -> nonzero exit, by design
+    try:
+        eng.shutdown()  # raises on a page leak -> nonzero exit, by design
+    finally:
+        if tele is not None:
+            tele.export()
     # Fast-path counters ride the drain event so the supervisor (and
     # doctor's serve report) can aggregate prefix reuse and speculative
     # acceptance across replicas without scraping flight logs.

@@ -72,12 +72,20 @@ class Plan:
     """One step's scheduling decision: requests to admit, in priority
     order, at most one live slot id to preempt first, waiting requests to
     expire (deadline missed before first token), and live slot ids to
-    cancel (total-latency budget blown mid-decode)."""
+    cancel (total-latency budget blown mid-decode).
+
+    ``reasons`` maps uid -> why an eligible waiting request was NOT
+    admitted this step (``backoff`` / ``tenant_cap`` / ``no_slot`` /
+    ``no_pages``); requests held only by admission order carry
+    ``priority``. The tracing layer classifies waiting time from it:
+    resource starvation (``no_pages``) is an admission stall, policy
+    holds are scheduler interference."""
 
     admit: tuple
     preempt: tuple
     expire: tuple = ()
     cancel: tuple = ()
+    reasons: dict = dataclasses.field(default_factory=dict)
 
     @property
     def empty(self) -> bool:
@@ -179,9 +187,11 @@ class SloScheduler:
                                       r.uid))
         admit: list = []
         preempt: list = []
+        reasons: dict = {}
         preempted_tenants: set[str] = set()
-        for req in order:
+        for idx, req in enumerate(order):
             if getattr(req, "not_before_s", 0.0) > now:
+                reasons[req.uid] = "backoff"
                 continue  # backing off after a retry: holds its place
             pol = self.policy(req.tenant)
             need = (need_pages(req) if need_pages is not None
@@ -189,9 +199,13 @@ class SloScheduler:
             if (pol.max_pages is not None
                     and tenant_pages.get(req.tenant, 0) + need
                     > pol.max_pages):
+                reasons[req.uid] = "tenant_cap"
                 continue  # over-budget tenant: holds its place, no slot
             if free_slots <= 0 or need > free_pages:
+                starve = "no_slot" if free_slots <= 0 else "no_pages"
                 if preempt:  # at most one eviction per plan
+                    for r in order[idx:]:
+                        reasons.setdefault(r.uid, starve)
                     break
                 # Slot- and page-starvation evict alike: the victim's
                 # slot AND pages both return.
@@ -205,13 +219,19 @@ class SloScheduler:
                     free_pages += victim.num_pages
                     free_slots += 1
                 else:
-                    break  # starved and nothing evictable: wait
+                    # Starved and nothing evictable: everything behind
+                    # this request (itself included) waits for the same
+                    # resource.
+                    for r in order[idx:]:
+                        reasons.setdefault(r.uid, starve)
+                    break
             admit.append(req)
             free_slots -= 1
             free_pages -= need
             tenant_pages[req.tenant] = tenant_pages.get(req.tenant, 0) + need
         return Plan(admit=tuple(admit), preempt=tuple(preempt),
-                    expire=tuple(expire), cancel=tuple(cancel))
+                    expire=tuple(expire), cancel=tuple(cancel),
+                    reasons=reasons)
 
     def _victim(self, live: Sequence, tenant_pages: dict,
                 exclude: set):

@@ -566,8 +566,10 @@ def test_serve_chaos_soak_sigkill_replica_token_identical(tmp_path):
     path: its in-flight requests are re-dispatched with their received
     prefix folded, every completion is token-identical to an uninterrupted
     run, the replacement replica warm-boots from the shared AOT cache, no
-    page leaks survive the drain, and the flight recorder tells the whole
-    story end to end."""
+    page leaks survive the drain, the flight recorder tells the whole
+    story end to end — and the merged Chrome trace links each
+    re-dispatched request's spans across BOTH replica processes under
+    one flow id (docs/serve_tracing.md)."""
     import dataclasses
     import os
 
@@ -602,7 +604,8 @@ def test_serve_chaos_soak_sigkill_replica_token_identical(tmp_path):
             workdir=str(tmp_path / "serve"),
             heartbeat_dir=str(tmp_path / "hb"),
             max_restarts=1, child_fault_plans={0: "sigkill@3"},
-            flight_dir=flight_dir, timeout_s=150.0)
+            flight_dir=flight_dir, timeout_s=150.0,
+            trace_dir=str(tmp_path / "trace"))
     finally:
         # run_serve exports the flight env for its children; scrub it so
         # later tests see a pristine recorder.
@@ -630,6 +633,31 @@ def test_serve_chaos_soak_sigkill_replica_token_identical(tmp_path):
     assert "replayed token-identically" in chain
     assert "restarted warm" in chain
     assert "drained with leak check ok" in chain
+
+    # The kill-replica acceptance pin for the tracing layer: the merged
+    # Chrome trace must link a re-dispatched request's spans across both
+    # replica processes — one flow id, two pids — and every emitted
+    # serve span name must come from the registered schema.
+    from distributeddeeplearning_tpu.observability import telemetry
+    from distributeddeeplearning_tpu.serve import tracing
+
+    assert out["merged_trace"] and os.path.exists(out["merged_trace"])
+    events = telemetry.load_events(out["merged_trace"])
+    emitted = {e["name"] for e in events
+               if str(e.get("name", "")).startswith("serve:")}
+    assert emitted <= set(tracing.REGISTERED_PHASES)
+    assert "serve:replica_lost" in emitted  # the supervisor's own track
+    flow_pids: dict = {}
+    for e in events:
+        if e.get("ph") in ("s", "t", "f") and e.get("cat") == "serve":
+            flow_pids.setdefault(e["id"], set()).add(e["pid"])
+    cross = {fid for fid, pids in flow_pids.items() if len(pids) > 1}
+    assert cross, "no flow chain spans both replica pids after the kill"
+    # The cross-process flows ARE the re-dispatched victims: each also
+    # left a final attribution instant on its second replica.
+    att_ids = {e["args"]["trace"] for e in events
+               if e.get("name") == "serve:attribution"}
+    assert cross <= att_ids
 
 
 @pytest.mark.slow

@@ -49,6 +49,17 @@ the SAME trace at each rate, assert token identity between them, and
 report each arm's best tokens/sec/chip among rates whose p99 TTFT still
 meets the SLO — raw throughput at blown latency does not count.
 ``speedup_at_slo`` is the fast/baseline ratio of those numbers.
+
+``--trace-dir DIR`` turns on per-request tracing (docs/serve_tracing.md):
+the continuous arm writes a Chrome trace to ``DIR/trace.p0.json`` and the
+record gains ``continuous.ttft_attribution`` — p50/p99/mean of each TTFT
+component (queue / admission_stall / prefill / interference / decode),
+reported only after every request's components are verified to sum back
+to its measured TTFT within 1 ms. With ``--chaos`` the supervised arm
+writes per-replica traces under ``DIR/chaos/`` and the bench asserts the
+re-dispatched requests' spans are flow-linked across both replica pids
+in the merged trace. Read the breakdown with
+``python tools/trace_report.py --serve DIR``.
 """
 
 from __future__ import annotations
@@ -74,6 +85,42 @@ def _pct(values, q):
 def _latency_block(ttfts, itls):
     return {"ttft_s": {"p50": _pct(ttfts, 50), "p99": _pct(ttfts, 99)},
             "itl_s": {"p50": _pct(itls, 50), "p99": _pct(itls, 99)}}
+
+
+def _ttft_attribution(requests) -> dict:
+    """Aggregate the tracer's per-request TTFT decomposition (queue /
+    admission_stall / prefill / interference / decode) into p50/p99/mean
+    per component, after asserting each request's components sum back to
+    its measured TTFT within 1 ms — an attribution that does not add up
+    is not reported."""
+    from distributeddeeplearning_tpu.serve import tracing
+
+    per_comp = {c: [] for c in tracing.COMPONENTS}
+    ttft_errs, total_errs = [], []
+    for r in requests:
+        rt = getattr(r, "trace", None)
+        if rt is None or rt.ttft_comp is None or r.ttft_s is None:
+            continue
+        ttft_errs.append(abs(sum(rt.ttft_comp.values()) - r.ttft_s))
+        if r.finished_s is not None:
+            total_errs.append(abs(sum(rt.comp.values())
+                                  - (r.finished_s - r.arrival_s)))
+        for c in tracing.COMPONENTS:
+            per_comp[c].append(rt.ttft_comp.get(c, 0.0))
+    if ttft_errs and max(ttft_errs) >= 1e-3:
+        raise AssertionError(
+            f"TTFT attribution components sum {max(ttft_errs) * 1e3:.3f} ms "
+            f"away from the measured TTFT — the exact-sum protocol is "
+            f"broken; do not trust the breakdown")
+    out = {c: {"p50": _pct(v, 50), "p99": _pct(v, 99),
+               "mean": round(sum(v) / len(v), 6) if v else None}
+           for c, v in per_comp.items()}
+    out["requests"] = len(ttft_errs)
+    out["max_ttft_sum_err_ms"] = (round(max(ttft_errs) * 1e3, 6)
+                                  if ttft_errs else None)
+    out["max_total_sum_err_ms"] = (round(max(total_errs) * 1e3, 6)
+                                   if total_errs else None)
+    return out
 
 
 def run_continuous(engine, trace, clock):
@@ -269,6 +316,14 @@ def main(argv=None) -> int:
                         "visits")
     p.add_argument("--skip-baseline", action="store_true",
                    help="continuous arm only (no speedup field)")
+    p.add_argument("--trace-dir", default=None,
+                   help="enable per-request tracing + TTFT attribution; "
+                        "the continuous arm's Chrome trace lands at "
+                        "<dir>/trace.p0.json and the record gains a "
+                        "ttft_attribution block (p50/p99/mean per "
+                        "component, exact-sum checked); with --chaos the "
+                        "supervised arm writes a merged multi-replica "
+                        "trace under <dir>/chaos/")
     p.add_argument("--chaos", action="store_true",
                    help="add a supervised chaos arm: the same trace "
                         "through launch.run_serve twice (2 replicas) — "
@@ -289,7 +344,14 @@ def main(argv=None) -> int:
     from distributeddeeplearning_tpu.models import flops as flopslib
     from distributeddeeplearning_tpu.observability import perf_report
     from distributeddeeplearning_tpu.observability import sidecars
+    from distributeddeeplearning_tpu.observability import telemetry
     from distributeddeeplearning_tpu.serve.engine import Engine, ServeConfig
+
+    if args.trace_dir:
+        # Must precede Engine construction: the engine resolves its
+        # tracer once, at build time (the zero-overhead-off contract).
+        telemetry.configure(enabled=True, trace_dir=args.trace_dir,
+                            process_index=0, process_name="bench-serve")
 
     prompt_lens = [int(x) for x in args.prompt_lens.split(",") if x]
     tenants = [t for t in args.tenants.split(",") if t]
@@ -400,6 +462,10 @@ def main(argv=None) -> int:
             **fast_path_counters(engine),
         }
         rec["aot"] = engine.aot_stats()
+        if args.trace_dir:
+            rec["continuous"]["ttft_attribution"] = _ttft_attribution(
+                cont["requests"])
+            rec["trace"] = telemetry.get().export()
 
         if not args.skip_baseline:
             seq = run_sequential(engine.model, {**engine._fresh}, trace,
@@ -447,11 +513,14 @@ def main(argv=None) -> int:
                 workdir=tempfile.mkdtemp(prefix="ddl-bserve-ok-"),
                 heartbeat_dir=tempfile.mkdtemp(prefix="ddl-bserve-okhb-"),
                 timeout_s=300.0)
+            chaos_trace_dir = (os.path.join(args.trace_dir, "chaos")
+                               if args.trace_dir else None)
             chaos_run = launchlib.run_serve(
                 2, reqs, cfg_dict,
                 workdir=tempfile.mkdtemp(prefix="ddl-bserve-chaos-"),
                 heartbeat_dir=tempfile.mkdtemp(prefix="ddl-bserve-chb-"),
-                child_fault_plans=plans, max_restarts=1, timeout_s=300.0)
+                child_fault_plans=plans, max_restarts=1, timeout_s=300.0,
+                trace_dir=chaos_trace_dir)
             mism = [uid for uid, r in chaos_run["results"].items()
                     if r["tokens"] != cont["requests"][int(uid)].tokens]
             if mism:
@@ -481,6 +550,30 @@ def main(argv=None) -> int:
                 "recovery_overhead_frac": round(
                     chaos_run["window_s"] / ok_run["window_s"] - 1, 3),
             }
+            if chaos_trace_dir and chaos_run.get("merged_trace"):
+                # The chaos arm's whole point under tracing: a request
+                # whose first replica was SIGKILLed must appear as ONE
+                # flow chain spanning two Chrome pids in the merged
+                # trace. Verify from the artifact, not from intent.
+                evs, _ = telemetry.load_events_tolerant(
+                    chaos_run["merged_trace"])
+                flow_pids: dict = {}
+                for e in evs:
+                    if (e.get("ph") in ("s", "t", "f")
+                            and e.get("cat") == "serve"):
+                        flow_pids.setdefault(e.get("id"),
+                                             set()).add(e.get("pid"))
+                cross = [fid for fid, pids in flow_pids.items()
+                         if len(pids) > 1]
+                rec["chaos"]["merged_trace"] = chaos_run["merged_trace"]
+                rec["chaos"]["flow_linked_requests"] = len(cross)
+                if chaos_run["redispatched"] and not cross:
+                    raise AssertionError(
+                        "replica death re-dispatched "
+                        f"{chaos_run['redispatched']} request(s) but "
+                        "the merged trace has no flow chain spanning two "
+                        "replica pids — cross-process trace linking is "
+                        "broken")
 
         mid_context = int(np.mean(prompt_lens)) + args.max_new // 2
         roof = flopslib.decode_roofline(

@@ -241,6 +241,11 @@ def build_report(flight_dir: str, *, trace_dir: str | None = None,
                 "phases": {k: v["total_ms"]
                            for k, v in s["phases"].items()},
                 "instants": [i["name"] for i in s["instants"]],
+                # Request-linkage evidence for serve incidents: a flow
+                # chain spanning two pids names a request that survived
+                # a replica death; an unclosed async track names one
+                # that never retired.
+                "flows": s.get("flows", {}),
             }
     return report
 
@@ -295,6 +300,13 @@ def print_report(r: dict) -> None:
               f"top phases: "
               + ", ".join(f"{k}={v:.1f}ms" for k, v in sorted(
                   t["phases"].items(), key=lambda kv: -kv[1])[:5]))
+        fl = t.get("flows") or {}
+        for c in fl.get("cross_process", ()):
+            print(f"  flow id {c['id']} spans pids {c['pids']} — request "
+                  f"re-dispatched across a replica death")
+        if fl.get("async_unclosed"):
+            print(f"  {len(fl['async_unclosed'])} request track(s) never "
+                  f"closed: ids {fl['async_unclosed'][:8]}")
 
 
 def main(argv=None) -> int:

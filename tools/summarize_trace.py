@@ -14,7 +14,15 @@ launch.py-merged chaos run) and prints, per file set:
   * counter tracks (HBM gauges, cumulative counts) as last-value + peak.
 
 A directory argument expands to its ``trace.p*.json`` files (the
---trace-dir layout). Truncated files are salvaged event-by-event and
+--trace-dir layout, which the serve stack's per-replica traces share);
+a directory holding only an already-merged ``trace.merged.json`` (a
+pulled serve artifact) falls back to that. Serve traces additionally
+get a **flow** summary: flow chains (``s``/``t``/``f`` events — one per
+request, docs/serve_tracing.md) grouped by id, flagging the chains that
+span more than one process — a re-dispatched request after a replica
+death shows up here as one id with two pids. Async request tracks
+(``b``/``e``) are checked for pairing; an unmatched begin means the
+request never retired. Truncated files are salvaged event-by-event and
 reported, not fatal — a post-mortem's trace is exactly the one most
 likely to be damaged.
 
@@ -47,7 +55,15 @@ def expand_traces(args: list[str]) -> list[str]:
     out: list[str] = []
     for a in args:
         if os.path.isdir(a):
-            out.extend(sorted(glob.glob(os.path.join(a, "trace.p*.json"))))
+            found = sorted(glob.glob(os.path.join(a, "trace.p*.json")))
+            if not found:
+                # A pulled serve artifact may hold only the supervisor's
+                # merged file (its name deliberately dodges the
+                # per-process glob so it is never double-counted).
+                merged = os.path.join(a, "trace.merged.json")
+                if os.path.exists(merged):
+                    found = [merged]
+            out.extend(found)
         else:
             out.append(a)
     return out
@@ -84,6 +100,44 @@ def summarize(paths: list[str]) -> dict:
                       "pid": e.get("pid"), "args": e.get("args", {})}
                      for e in instants],
         "counters": counters,
+        "flows": flow_summary(events),
+    }
+
+
+def flow_summary(events: list[dict]) -> dict:
+    """Serve-trace request linkage: flow chains grouped by (cat, id) —
+    the ones spanning >1 pid are re-dispatched requests whose life
+    crossed a replica death — plus async b/e pairing (an unmatched begin
+    is a request that never retired)."""
+    chains: dict = {}
+    for e in events:
+        if e.get("ph") not in ("s", "t", "f"):
+            continue
+        c = chains.setdefault((e.get("cat", ""), e.get("id")),
+                              {"name": e.get("name"), "pids": set(),
+                               "phases": []})
+        c["pids"].add(e.get("pid"))
+        c["phases"].append(e["ph"])
+    begun: dict = {}
+    unmatched_ends = 0
+    for e in events:
+        if e.get("ph") == "b":
+            begun[(e.get("cat", ""), e.get("id"), e.get("name"))] = True
+        elif e.get("ph") == "e":
+            k = (e.get("cat", ""), e.get("id"), e.get("name"))
+            if begun.pop(k, None) is None:
+                unmatched_ends += 1
+    cross = sorted((key for key, c in chains.items()
+                    if len(c["pids"]) > 1), key=lambda k: str(k[1]))
+    return {
+        "chains": len(chains),
+        "cross_process": [
+            {"id": key[1], "name": chains[key]["name"],
+             "pids": sorted(chains[key]["pids"], key=str),
+             "events": len(chains[key]["phases"])}
+            for key in cross],
+        "async_unclosed": sorted(str(k[1]) for k in begun),
+        "async_unmatched_ends": unmatched_ends,
     }
 
 
@@ -114,6 +168,18 @@ def print_tables(s: dict) -> None:
             c = s["counters"][name]
             print(f"  {name:<40}{c['last']:>16g}{c['peak']:>16g}"
                   f"{c['n']:>8}")
+    fl = s.get("flows") or {}
+    if fl.get("chains"):
+        print(f"\nflow chains: {fl['chains']} "
+              f"({len(fl['cross_process'])} cross-process)")
+        for c in fl["cross_process"]:
+            print(f"  id {c['id']}  {c['name']}  pids {c['pids']}  "
+                  f"{c['events']} events  <- re-dispatched across "
+                  f"processes")
+    if fl.get("async_unclosed"):
+        print(f"\nWARNING: {len(fl['async_unclosed'])} request track(s) "
+              f"never closed (ids {fl['async_unclosed'][:8]}) — these "
+              f"requests did not retire")
 
 
 def main(argv=None) -> int:

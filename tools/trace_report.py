@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Where did my latency go? — attribution report over a serve trace.
+
+    python tools/trace_report.py --serve TRACE_OR_DIR [--json] [--top N]
+
+Reads the Chrome trace(s) a traced serve run wrote (``--trace-dir`` on
+tools/bench_serve.py, ``--serve-trace-dir`` on launch.py, or an
+already-merged ``trace.merged.json``) and reports the per-request
+latency decomposition the engine's tracer emitted (docs/serve_tracing.md):
+
+  * the per-request table — TTFT, total latency, and each attribution
+    component (queue / admission_stall / prefill / interference /
+    decode), slowest TTFT first;
+  * aggregate p50/p99/mean per component, over TTFT and total latency;
+  * the **critical-path table for the p99 tail**: mean component shares
+    of TTFT among the requests at/above the p99, next to the same shares
+    over the whole population — the component whose share GROWS in the
+    tail is where the p99 went;
+  * cross-process flow links — requests re-dispatched after a replica
+    death, whose one flow id spans two replica pids in the merged trace.
+
+The components are exhaustive by construction (they sum to the measured
+latency within float error; the ``sum_err_s`` field in every attribution
+instant is the proof), so the tables account for *all* wall-clock, not a
+sampled subset. Pure stdlib + the telemetry loaders; no jax, safe to run
+on a laptop against a pulled artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddeeplearning_tpu.observability import perf_report  # noqa: E402
+from distributeddeeplearning_tpu.observability import telemetry  # noqa: E402
+from distributeddeeplearning_tpu.observability.metrics import (  # noqa: E402
+    percentile)
+from distributeddeeplearning_tpu.serve import tracing  # noqa: E402
+
+
+def expand(target: str) -> list[str]:
+    """A trace file, or a --trace-dir directory (its ``trace.p*.json``
+    set, falling back to an already-merged ``trace.merged.json``)."""
+    if not os.path.isdir(target):
+        return [target]
+    found = sorted(glob.glob(os.path.join(target, "trace.p*.json")))
+    if not found:
+        merged = os.path.join(target, "trace.merged.json")
+        if os.path.exists(merged):
+            found = [merged]
+    return found
+
+
+def serve_report(events: list[dict]) -> dict:
+    """The attribution tables from one event set (see module doc)."""
+    reqs = [dict(e.get("args", {}), pid=e.get("pid"))
+            for e in events
+            if e.get("ph") == "i" and e.get("name") == "serve:attribution"]
+    with_ttft = [r for r in reqs if r.get("ttft_s") is not None]
+    ttfts = [r["ttft_s"] for r in with_ttft]
+    totals = [r["total_s"] for r in reqs if r.get("total_s") is not None]
+
+    agg = {"requests": len(reqs), "with_first_token": len(with_ttft),
+           "ttft_s": {"p50": percentile(ttfts, 50),
+                      "p99": percentile(ttfts, 99)},
+           "total_s": {"p50": percentile(totals, 50),
+                       "p99": percentile(totals, 99)},
+           "components": {}}
+    for c in tracing.COMPONENTS:
+        tvals = [r["ttft_components"].get(c, 0.0) for r in with_ttft]
+        avals = [r["components"].get(c, 0.0) for r in reqs
+                 if r.get("components")]
+        agg["components"][c] = {
+            "ttft": {"p50": percentile(tvals, 50),
+                     "p99": percentile(tvals, 99),
+                     "mean": (sum(tvals) / len(tvals)) if tvals else None},
+            "total": {"p50": percentile(avals, 50),
+                      "p99": percentile(avals, 99),
+                      "mean": (sum(avals) / len(avals)) if avals else None},
+        }
+
+    # Critical path at the p99 tail: component shares of TTFT among the
+    # requests at/above the p99, vs the same shares over everybody. The
+    # component whose share grows in the tail is the p99's bottleneck.
+    tail = {}
+    p99 = agg["ttft_s"]["p99"]
+    if p99 is not None:
+        tail_reqs = [r for r in with_ttft if r["ttft_s"] >= p99]
+
+        def shares(rows):
+            sums = {c: sum(r["ttft_components"].get(c, 0.0) for r in rows)
+                    for c in tracing.COMPONENTS}
+            denom = sum(sums.values()) or 1.0
+            return {c: v / denom for c, v in sums.items()}
+
+        body_share, tail_share = shares(with_ttft), shares(tail_reqs)
+        tail = {
+            "threshold_ttft_s": p99,
+            "requests": [r.get("trace") for r in tail_reqs],
+            "shares": {c: {"all": round(body_share[c], 4),
+                           "p99_tail": round(tail_share[c], 4),
+                           "delta": round(tail_share[c] - body_share[c], 4)}
+                       for c in tracing.COMPONENTS},
+            "dominant": max(tracing.COMPONENTS, key=lambda c: tail_share[c]),
+        }
+
+    flow_pids: dict = {}
+    for e in events:
+        if e.get("ph") in ("s", "t", "f") and e.get("cat") == "serve":
+            flow_pids.setdefault(e.get("id"), set()).add(e.get("pid"))
+    cross = [{"id": fid, "pids": sorted(pids, key=str)}
+             for fid, pids in sorted(flow_pids.items(), key=lambda kv:
+                                     str(kv[0]))
+             if len(pids) > 1]
+
+    max_err = max((abs(r.get("sum_err_s", 0.0)) for r in reqs),
+                  default=0.0)
+    return {"requests": sorted(reqs, key=lambda r:
+                               -(r.get("ttft_s") or r.get("total_s") or 0)),
+            "aggregate": agg, "p99_critical_path": tail,
+            "cross_process_flows": cross,
+            "max_sum_err_s": max_err}
+
+
+def print_report(rep: dict, top: int) -> None:
+    agg = rep["aggregate"]
+    print(f"{agg['requests']} request(s), {agg['with_first_token']} with "
+          f"a first token, max attribution sum error "
+          f"{rep['max_sum_err_s'] * 1e3:.4f} ms")
+    t, tot = agg["ttft_s"], agg["total_s"]
+    if t["p50"] is not None:
+        print(f"TTFT p50 {t['p50']:.4f}s  p99 {t['p99']:.4f}s;  "
+              f"total p50 {tot['p50']:.4f}s  p99 {tot['p99']:.4f}s")
+
+    rows = rep["requests"][:top]
+    if rows:
+        comps = list(tracing.COMPONENTS)
+        hdr = "".join(f"{c[:10]:>12}" for c in comps)
+        print(f"\nslowest {len(rows)} by TTFT:")
+        print(f"{'trace':>8}{'status':>10}{'ttft_s':>10}{'total_s':>10}"
+              f"{hdr}  (component seconds, of total)")
+        for r in rows:
+            comp = r.get("components", {})
+            ttft = r.get("ttft_s")
+            print(f"{str(r.get('trace')):>8}{r.get('status', '?'):>10}"
+                  f"{(f'{ttft:.4f}' if ttft is not None else '-'):>10}"
+                  f"{r.get('total_s', 0.0):>10.4f}"
+                  + "".join(f"{comp.get(c, 0.0):>12.4f}" for c in comps))
+
+    print("\nTTFT components (p50 / p99 / mean seconds):")
+    for c in tracing.COMPONENTS:
+        s = agg["components"][c]["ttft"]
+        if s["mean"] is None:
+            continue
+        print(f"  {c:<18}{s['p50']:>10.4f}{s['p99']:>10.4f}"
+              f"{s['mean']:>10.4f}")
+
+    cp = rep["p99_critical_path"]
+    if cp:
+        print(f"\np99 critical path (TTFT >= {cp['threshold_ttft_s']:.4f}s, "
+              f"{len(cp['requests'])} request(s)):")
+        print(f"  {'component':<18}{'share(all)':>12}{'share(p99)':>12}"
+              f"{'delta':>8}")
+        for c in tracing.COMPONENTS:
+            s = cp["shares"][c]
+            mark = "  <- dominant" if c == cp["dominant"] else ""
+            print(f"  {c:<18}{s['all']:>12.1%}{s['p99_tail']:>12.1%}"
+                  f"{s['delta']:>+8.1%}{mark}")
+
+    if rep["cross_process_flows"]:
+        print("\ncross-process requests (re-dispatched after a replica "
+              "death):")
+        for f in rep["cross_process_flows"]:
+            print(f"  flow id {f['id']}  pids {f['pids']}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--serve", metavar="TRACE_OR_DIR", required=True,
+                   help="serve trace file, or trace dir "
+                        "(trace.p*.json / trace.merged.json)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON object instead of "
+                        "tables")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows in the per-request table (slowest first)")
+    args = p.parse_args(argv)
+    paths = expand(args.serve)
+    if not paths:
+        p.error(f"no trace.p*.json or trace.merged.json under "
+                f"{args.serve}")
+    events: list[dict] = []
+    load_errors: list[str] = []
+    for path in paths:
+        evs, err = telemetry.load_events_tolerant(path)
+        events.extend(evs)
+        if err:
+            load_errors.append(err)
+    rep = serve_report(events)
+    rep["files"], rep["load_errors"] = paths, load_errors
+    # with_backend=False: a trace reader must never import jax.
+    if rep["aggregate"]["requests"]:
+        perf_report.annotate(rep, provenance="fresh", with_backend=False)
+    else:
+        rep["error"] = ("; ".join(load_errors)
+                        or "no serve:attribution events — was the run "
+                           "traced? (bench_serve --trace-dir / launch.py "
+                           "--serve-trace-dir)")
+        perf_report.annotate(rep, provenance="error", with_backend=False)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        for err in load_errors:
+            print(f"WARNING: {err} — tables below are incomplete")
+        if rep["aggregate"]["requests"]:
+            print_report(rep, args.top)
+        else:
+            print(rep["error"], file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -125,6 +125,17 @@ SUITE = (
     ("bert2048_flash", "bert_base", {"batch_size": 32, "seq_len": 2048,
                                      "attention_impl": "flash",
                                      "remat": True}, 180),
+    # Large-batch %-of-peak A/B (ISSUE 20), reached by NAME via the gated
+    # DDL_LARGEBATCH=1 chip-window step: identical model at 2x the headline
+    # per-chip batch, differing ONLY in precision policy. The fp32 arm
+    # scores against the fp32 roof and the mixed arm (bf16 compute + fp32
+    # masters + dynamic loss scaling) against the bf16 roof, so the pair
+    # reads as distance-from-own-speed-of-light (arXiv 1711.04325); each
+    # emits under its own _<precision> metric name.
+    ("largebatch_fp32", "resnet50", {"batch_size": 1024,
+                                     "precision": "fp32"}, 120),
+    ("largebatch_bf16", "resnet50", {"batch_size": 1024,
+                                     "precision": "mixed"}, 120),
     # Pipeline-schedule A/B (models/pipeline.py), after the value-per-minute
     # prefix — chip windows reach these by NAME via the gated DDL_PIPELINE=1
     # pipeline_ab step, never by budget order. Fill/drain GPipe vs
@@ -190,6 +201,14 @@ def _metric_name_unit(args) -> tuple[str, str]:
         sched = getattr(args, "pipeline_schedule", "gpipe") or "gpipe"
         vv = getattr(args, "pipeline_virtual_stages", 1) or 1
         perleaf += f"_pp{pp}_{sched}" + (f"v{vv}" if vv > 1 else "")
+    # Precision-policy A/B rows (ISSUE 20): the fp32 reference arm and the
+    # mixed (bf16 compute + fp32 masters + dynamic loss scaling) arm are
+    # different measurement protocols scoring against different rooflines —
+    # each gets its own metric name so neither can evict the other's (or
+    # the default row's) last-good entry.
+    prec = getattr(args, "precision", None)
+    if prec:
+        perleaf += f"_{prec}"
     # Tracing adds per-step clock reads inside the timed window — protocol
     # drift by design (it's how the overhead A/B measures itself), so traced
     # numbers live under their own metric name and can never evict an
@@ -235,6 +254,20 @@ def _protocol_suffix(args) -> str:
             parts.append("no-overlap")
     if getattr(args, "opt_state_offload", False):
         parts.append("opt-offload")
+    prec = getattr(args, "precision", None)
+    if prec:
+        # Spell the policy out (compute/param/reduce + loss scale) so the
+        # record says WHAT "mixed" meant when it was measured, not just
+        # that it was.
+        try:
+            from distributeddeeplearning_tpu.config import PrecisionPolicy
+            pol = (PrecisionPolicy.mixed() if prec == "mixed"
+                   else PrecisionPolicy.fp32())
+            parts.append(pol.describe())
+        except Exception:
+            parts.append(f"prec-{prec}")
+    elif getattr(args, "dtype", None):
+        parts.append(args.dtype)
     pp = getattr(args, "pp", 1) or 1
     if pp > 1:
         parts.append(f"pp{pp}-{getattr(args, 'pipeline_schedule', 'gpipe')}"
@@ -267,9 +300,20 @@ def _mfu_fields(args, value: float) -> dict:
             return {}
         out = {"tflops_per_sec": round(value * per_ex / 1e12, 2)}
         import jax
-        peak = flopslib.bf16_peak_flops(jax.devices()[0].device_kind)
+        # %-of-peak scores against the roof of the arm's OWN compute dtype
+        # (models/flops.py peak tables): the fp32 reference arm vs the
+        # fp32 roof, the mixed/bf16 arm vs the bf16 roof — each measures
+        # distance from its own speed of light (arXiv 1711.04325 axis).
+        prec = getattr(args, "precision", None)
+        compute = ("float32"
+                   if prec == "fp32" or (prec is None and
+                                         getattr(args, "dtype", None)
+                                         == "float32")
+                   else "bfloat16")
+        peak = flopslib.peak_flops(jax.devices()[0].device_kind, compute)
         if peak:
             out["mfu_pct"] = round(100.0 * value * per_ex / peak, 1)
+            out["peak_dtype"] = compute
         return out
     except Exception:
         return {}
@@ -383,10 +427,23 @@ def _child_measure(args, emit_quick: bool = True,
         ar_kw["bucket_mb"] = args.allreduce_bucket_mb
     if getattr(args, "allreduce_dtype", None):
         ar_kw["dtype"] = args.allreduce_dtype
+    # Precision-policy A/B arms (ISSUE 20): --precision selects an explicit
+    # policy (the compute dtype follows the policy); bare --dtype covers
+    # legacy-knob runs. Default stays the bf16 protocol of record.
+    prec_kw = {}
+    dtype = getattr(args, "dtype", None) or "bfloat16"
+    prec = getattr(args, "precision", None)
+    if prec:
+        from distributeddeeplearning_tpu.config import PrecisionPolicy
+        pol = (PrecisionPolicy.mixed() if prec == "mixed"
+               else PrecisionPolicy.fp32())
+        prec_kw["precision"] = pol
+        dtype = pol.compute_dtype
     cfg = TrainConfig(
         model=args.model,
         global_batch_size=args.batch_size * n_dev,
-        dtype="bfloat16",
+        dtype=dtype,
+        **prec_kw,
         log_every=10**9,  # silent; bench prints only metric lines on stdout
         attention_impl=args.attention_impl,
         remat=args.remat,
@@ -532,6 +589,15 @@ def _child_measure(args, emit_quick: bool = True,
         from distributeddeeplearning_tpu.perf import aot as aotlib
         cold["config_fingerprint"] = aotlib.config_fingerprint(
             cfg, total_steps=total)
+    except Exception:
+        pass  # annotation only
+    try:
+        # Policy + ramp provenance on every line (ISSUE 20): an fp32 and a
+        # mixed arm (or a ramped and unramped run) must never be conflated.
+        from distributeddeeplearning_tpu.config import resolve_precision
+        from distributeddeeplearning_tpu.train import optim as optimlib
+        cold["precision"] = resolve_precision(cfg).describe()
+        cold["batch_ramp"] = optimlib.ramp_describe(cfg)
     except Exception:
         pass  # annotation only
     if compile_time_s is not None:
@@ -713,6 +779,7 @@ def _child(args) -> int:
         row.overlap_collectives, row.opt_state_offload = True, False
         row.pp, row.pipeline_schedule = 1, "gpipe"
         row.pipeline_virtual_stages = 1
+        row.dtype = row.precision = None
         for k, v in overrides.items():
             setattr(row, k, v)
         row_deadline = None
@@ -1236,6 +1303,20 @@ def main(argv=None) -> int:
                    help="virtual chunks per stage for --pipeline-schedule "
                         "1f1b (V>1 shrinks the bubble to "
                         "(P-1)/(M*V+P-1)); must divide layers-per-stage")
+    p.add_argument("--dtype", default=None,
+                   choices=[None, "float32", "bfloat16"],
+                   help="compute dtype via the legacy knob (unset = the "
+                        "bfloat16 protocol of record); subsumed by "
+                        "--precision when that is set")
+    p.add_argument("--precision", default=None,
+                   choices=[None, "fp32", "mixed"],
+                   help="explicit precision policy (config.PrecisionPolicy) "
+                        "for the large-batch %%-of-peak A/B: 'fp32' = "
+                        "everything float32 scored against the fp32 roof, "
+                        "'mixed' = bf16 compute + fp32 master weights + "
+                        "dynamic loss scaling scored against the bf16 roof; "
+                        "each arm emits under its own _<precision> metric "
+                        "name (docs/mixed_precision.md)")
     p.add_argument("--opt-state-offload", action="store_true",
                    help="place sharded optimizer-state chunks in host RAM "
                         "(pinned_host memory kind) where the backend "
@@ -1439,6 +1520,10 @@ def main(argv=None) -> int:
         child_cmd += ["--no-overlap-collectives"]
     if args.opt_state_offload:
         child_cmd += ["--opt-state-offload"]
+    if args.dtype:
+        child_cmd += ["--dtype", args.dtype]
+    if args.precision:
+        child_cmd += ["--precision", args.precision]
     if args.pp > 1:
         child_cmd += ["--pp", str(args.pp)]
     if args.pipeline_schedule != "gpipe":

@@ -37,6 +37,13 @@ cannot quietly regress it:
   (docs/serve_tracing.md) is what tools/trace_report.py and the
   attribution tests key on — an unregistered name is a span the whole
   reporting stack silently ignores.
+- ``master-weight-cast``: optimizer / master-weight state must stay
+  float32 (ISSUE 20's silent-precision-loss bug class: a bf16 master
+  drops every update below ~2^-8 of the weight magnitude and training
+  quietly plateaus). Any cast of a value whose name mentions
+  ``opt_state`` / ``master`` to a sub-fp32 dtype (``astype``, or a
+  ``dtype=``-carrying array constructor) outside the sanctioned
+  gather-path helpers in ``parallel/zero.py`` is flagged.
 - ``axis-name-consistency``: string axis names at ``psum`` /
   ``psum_scatter`` / ``all_gather`` / ``pmean`` / ... call sites must be
   declared in ``parallel/mesh.py``'s ``MESH_AXES`` — a typo'd axis name
@@ -485,10 +492,103 @@ def check_serve_span_registry(tree: ast.Module, path: str) -> list[dict]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# master-weight-cast
+# ---------------------------------------------------------------------------
+
+# Identifier fragments that mark a value as optimizer / master-weight
+# state. Deliberately narrow (no "mu"/"nu"): the gate fails tier-1 and a
+# noisy rule gets baselined into uselessness.
+_MASTER_STATE_MARKERS = ("opt_state", "master")
+# Sub-fp32 dtypes a master must never land in. fp32 and wider are fine;
+# integer casts are shape bookkeeping, not precision loss.
+_SUB_FP32_DTYPES = {"bfloat16", "float16", "bf16", "f16", "half"}
+# The sanctioned policy helpers: parallel/zero.py's gather path casts
+# *gathered params* to the policy's compute dtype on the wire (the
+# sharded fp32 masters themselves are never rewritten — _scatter_members
+# restores plan dtypes). A new helper that legitimately moves values out
+# of fp32 is added here in the same diff that introduces it.
+_MASTER_CAST_SANCTIONED = {"_gather_members", "all_gather_chunks",
+                           "gather_params_overlapped"}
+# Array constructors whose dtype= keyword retypes their first argument.
+_DTYPE_KW_CONSTRUCTORS = {"asarray", "array", "full_like", "zeros_like",
+                          "ones_like", "empty_like"}
+
+
+def _dtype_token(node: ast.expr) -> Optional[str]:
+    """The dtype a cast targets, as a lowercase token: 'bfloat16' from
+    ``jnp.bfloat16`` / ``"bfloat16"`` / ``np.float16``; None when the
+    dtype is not a statically readable literal."""
+    s = _const_str(node)
+    if s is not None:
+        return s.lower()
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        name = _terminal_name(node)
+        return name.lower() if name else None
+    return None
+
+
+def _mentions_master_state(node: ast.expr) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident and any(m in ident.lower()
+                         for m in _MASTER_STATE_MARKERS):
+            return True
+    return False
+
+
+def _master_casts_in_scope(scope: ast.AST, path: str) -> list[dict]:
+    out = []
+    for node in _shallow_walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        tok = target = None
+        if (name == "astype" and isinstance(node.func, ast.Attribute)
+                and node.args):
+            tok = _dtype_token(node.args[0])
+            target = node.func.value
+        elif name in _DTYPE_KW_CONSTRUCTORS and node.args:
+            kw = next((k for k in node.keywords if k.arg == "dtype"), None)
+            if kw is not None:
+                tok = _dtype_token(kw.value)
+                target = node.args[0]
+        if (tok in _SUB_FP32_DTYPES and target is not None
+                and _mentions_master_state(target)):
+            out.append(finding(
+                "lints", "master-weight-cast",
+                f"optimizer/master state cast to {tok} — master weights "
+                f"and optimizer state stay float32 (a bf16 master drops "
+                f"every update below ~2^-8 of the weight magnitude; "
+                f"docs/mixed_precision.md). Wire-compression belongs in "
+                f"the sanctioned parallel/zero.py gather helpers",
+                file=path, line=node.lineno))
+    return out
+
+
+def check_master_weight_cast(tree: ast.Module, path: str) -> list[dict]:
+    """Flag sub-fp32 casts of optimizer / master-weight state outside the
+    sanctioned policy helpers. Scope-aware: each function body is
+    scanned once (via ``_shallow_walk``), and bodies of helpers in
+    :data:`_MASTER_CAST_SANCTIONED` are skipped entirely."""
+    findings = _master_casts_in_scope(tree, path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in _MASTER_CAST_SANCTIONED:
+            continue
+        findings.extend(_master_casts_in_scope(node, path))
+    return findings
+
+
 _CHECKS = (check_sidecar_writes, check_fsync_before_fire,
            check_unpaired_spans, check_perf_record_provenance,
            check_page_table_log_before_dispatch, check_cow_before_write,
-           check_serve_span_registry)
+           check_serve_span_registry, check_master_weight_cast)
 
 
 def analyze_source(src: str, path: str = "<memory>", *,

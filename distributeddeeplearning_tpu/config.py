@@ -112,6 +112,110 @@ class DataConfig:
                                   # (B, S) labels
 
 
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """End-to-end mixed-precision policy (docs/mixed_precision.md).
+
+    One object names every dtype decision the large-batch recipes care
+    about (PAPERS.md: arXiv 1711.04325 trains ResNet-50 at 32k in mixed
+    precision), instead of the three half-coordinated knobs the legacy
+    path spreads across ``TrainConfig.dtype`` / ``AllReduceConfig.dtype``:
+
+    - ``compute_dtype`` — forward/backward activation (and zero3 gathered-
+      parameter) dtype;
+    - ``param_dtype`` — the persistent master weights + optimizer state.
+      MUST stay ``float32``: the update ``p - lr*g`` at bf16 resolution
+      silently loses every increment below ~2^-8 of the weight magnitude
+      (the silent-precision-loss bug class ddl-lint's
+      ``master-weight-cast`` rule exists for);
+    - ``reduce_dtype`` — gradient all-reduce / reduce-scatter wire payload
+      (bfloat16 halves wire bytes; fp32 masters are restored after);
+    - ``loss_scale`` — initial DYNAMIC loss scale (0 = off). The loss is
+      multiplied by the scale before backward and gradients divided after;
+      a non-finite scaled gradient skips the update and halves the scale,
+      ``loss_scale_growth_interval`` consecutive good steps double it
+      (bounded to [``loss_scale_min``, ``loss_scale_max``]). A scale
+      backoff is a *controlled* event — it reports under its own
+      ``loss_scale_skip`` metric and never increments the bad-step
+      anomaly counter (train/loop.py ``_BadStepTracker``).
+
+    The policy is part of the AOT ``config_fingerprint`` (perf/aot.py
+    hashes the whole config dataclass), so fp32 and mixed arms key
+    separate executables and separate perf baselines by construction.
+    """
+
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    reduce_dtype: str = "bfloat16"
+    loss_scale: float = 0.0
+    loss_scale_growth_interval: int = 200
+    loss_scale_min: float = 1.0
+    loss_scale_max: float = 65536.0
+
+    @classmethod
+    def mixed(cls) -> "PrecisionPolicy":
+        """The large-batch mixed arm: bf16 compute + wire, fp32 masters,
+        dynamic loss scaling armed (bf16 shares fp32's exponent range, so
+        the scale rarely moves — it exists to catch the overflow tail)."""
+        return cls(compute_dtype="bfloat16", reduce_dtype="bfloat16",
+                   loss_scale=32768.0)
+
+    @classmethod
+    def fp32(cls) -> "PrecisionPolicy":
+        """The A/B reference arm: everything float32, no scaling."""
+        return cls(compute_dtype="float32", reduce_dtype="float32",
+                   loss_scale=0.0)
+
+    def describe(self) -> str:
+        """Compact provenance tag, e.g. ``bf16/f32/bf16+dls32768``."""
+        short = {"float32": "f32", "bfloat16": "bf16"}
+        tag = (f"{short.get(self.compute_dtype, self.compute_dtype)}/"
+               f"{short.get(self.param_dtype, self.param_dtype)}/"
+               f"{short.get(self.reduce_dtype, self.reduce_dtype)}")
+        if self.loss_scale > 0:
+            tag += f"+dls{self.loss_scale:g}"
+        return tag
+
+
+def resolve_precision(config: "TrainConfig") -> PrecisionPolicy:
+    """The run's effective precision policy. ``config.precision=None``
+    (default) derives the legacy behavior — compute at ``config.dtype``,
+    fp32 params, reduction payload per ``config.allreduce`` — so every
+    existing config compiles the exact same program as before the policy
+    existed. An explicit policy is validated here, once, on the way in."""
+    policy = getattr(config, "precision", None)
+    if policy is None:
+        return PrecisionPolicy(
+            compute_dtype=config.dtype, param_dtype="float32",
+            reduce_dtype=getattr(config.allreduce, "dtype", "float32"),
+            loss_scale=0.0)
+    for field, value in (("compute_dtype", policy.compute_dtype),
+                         ("reduce_dtype", policy.reduce_dtype)):
+        if value not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"PrecisionPolicy.{field}={value!r}: use 'float32' or "
+                f"'bfloat16'")
+    if policy.param_dtype != "float32":
+        raise ValueError(
+            f"PrecisionPolicy.param_dtype={policy.param_dtype!r}: master "
+            f"weights must stay float32 — a bf16 master silently drops "
+            f"every update below ~2^-8 of the weight magnitude "
+            f"(docs/mixed_precision.md)")
+    if policy.loss_scale < 0:
+        raise ValueError(f"loss_scale must be >= 0 "
+                         f"(got {policy.loss_scale})")
+    if policy.loss_scale > 0:
+        if policy.loss_scale_growth_interval < 1:
+            raise ValueError("loss_scale_growth_interval must be >= 1")
+        if not (0 < policy.loss_scale_min <= policy.loss_scale
+                <= policy.loss_scale_max):
+            raise ValueError(
+                f"need 0 < loss_scale_min <= loss_scale <= loss_scale_max "
+                f"(got {policy.loss_scale_min} / {policy.loss_scale} / "
+                f"{policy.loss_scale_max})")
+    return policy
+
+
 @dataclasses.dataclass
 class OptimizerConfig:
     """Optimizer + schedule (SGD-momentum default; LARS for config 5)."""
@@ -150,7 +254,28 @@ class TrainConfig:
     num_epochs: float = 90.0
     steps_per_epoch: Optional[int] = None  # derived from dataset if None
     total_steps: Optional[int] = None      # overrides epochs when set
-    dtype: str = "bfloat16"       # compute dtype; params stay f32
+    dtype: str = "bfloat16"       # compute dtype; params stay f32. Subsumed
+                                  # by ``precision`` when that is set — kept
+                                  # as the legacy knob so every existing
+                                  # config compiles unchanged
+    precision: Optional[PrecisionPolicy] = None  # end-to-end mixed-precision
+                                  # policy (compute/param/reduce dtypes +
+                                  # dynamic loss scaling). None derives the
+                                  # legacy behavior from ``dtype`` and
+                                  # ``allreduce.dtype`` (resolve_precision);
+                                  # part of the AOT config_fingerprint, so
+                                  # fp32 and mixed arms never share an
+                                  # executable or a perf baseline
+    batch_ramp: Optional[str] = None  # staged global-batch ramp (arXiv
+                                  # 1711.04325 recipe), e.g. "8192:600,32768":
+                                  # comma stages of batch[:steps], last stage
+                                  # (no :steps) runs to the horizon and must
+                                  # equal global_batch_size. LR follows the
+                                  # linear-scaling rule per stage; every
+                                  # boundary must land on a checkpoint
+                                  # cadence step (train/optim.py
+                                  # parse_batch_ramp validates) so resume and
+                                  # elastic re-formation compose unchanged
     grad_accum_steps: int = 1     # microbatches per optimizer step (config 5
                                   # at 32k runs on any mesh via accumulation)
     steps_per_loop: int = 1       # train steps fused into ONE XLA program

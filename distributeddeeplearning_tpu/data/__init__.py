@@ -47,6 +47,40 @@ def resolve_loader(config: TrainConfig, input_kind: str) -> str:
     return loader
 
 
+def effective_prefetch_depth(config: TrainConfig) -> int:
+    """Lookahead depth for the device prefetch buffer (StreamSource).
+
+    ``config.data.prefetch_depth`` is the floor (legacy behavior —
+    double-buffering at depth 2). Large-batch runs deepen it so host
+    decode keeps ``data_wait_frac`` ~0 with 2x-batch headroom (ISSUE 20;
+    the headroom is measured and stamped into every run summary's
+    ``input_pipeline`` block):
+
+    - an explicit precision policy marks a large-batch recipe run —
+      double the configured depth;
+    - a batch ramp additionally scales depth by ceil(final/stage) during
+      the early stages, so the host pipeline is provisioned for the
+      FINAL batch while the device still consumes the small one (the
+      stage boundary would otherwise start with an empty buffer exactly
+      when the batch doubles).
+
+    Host-side only: ``prefetch_depth`` is a VOLATILE fingerprint field,
+    so the deepened buffer never shifts the AOT program identity.
+    """
+    depth = config.data.prefetch_depth
+    if depth <= 0:
+        return depth
+    scale = 1
+    if getattr(config, "precision", None) is not None:
+        scale = 2
+    if getattr(config, "batch_ramp", None):
+        from distributeddeeplearning_tpu.train import optim
+        final = optim.ramp_final_batch(config)
+        scale = max(scale,
+                    -(-int(final) // max(config.global_batch_size, 1)))
+    return depth * scale
+
+
 def make_source(config: TrainConfig, input_kind: str,
                 sharding: Optional[jax.sharding.Sharding] = None, *,
                 start_step: int = 0, train: bool = True,

@@ -438,8 +438,9 @@ def make_imagenet_source(config: TrainConfig, sharding, *, train: bool = True,
         n_local = len(folder_index(config.data.data_dir, "val")[0]
                       [jax.process_index()::jax.process_count()])
         hint = n_local // _per_process_batch(config, jax.process_count())
+    from distributeddeeplearning_tpu import data as datalib
     return StreamSource(ds.as_numpy_iterator(), sharding,
                         first_step=start_step,
-                        depth=config.data.prefetch_depth,
+                        depth=datalib.effective_prefetch_depth(config),
                         batches_hint=hint,
                         **stream_guard_kwargs(config, train=train))

@@ -192,6 +192,7 @@ def make_native_source(config, sharding, *, train: bool = True,
     from distributeddeeplearning_tpu.data import imagenet
 
     d = config.data
+    from distributeddeeplearning_tpu import data as datalib
     paths, labels = imagenet.folder_index(
         d.data_dir, "train" if train else "val")
     pidx, pcount = jax.process_index(), jax.process_count()
@@ -201,7 +202,8 @@ def make_native_source(config, sharding, *, train: bool = True,
     loader = NativeImageLoader(
         paths, labels, batch_size=per_process, image_size=d.image_size,
         train=train, seed=config.seed, start_batch=start_step if train else 0,
-        queue_depth=max(d.prefetch_depth + 1, 2))
+        queue_depth=max(datalib.effective_prefetch_depth(config) + 1,
+                        2))
 
     it = iter(loader)
     if config.dtype == "bfloat16":
@@ -212,7 +214,8 @@ def make_native_source(config, sharding, *, train: bool = True,
                     "label": b["label"]}
         it = (cast(b) for b in it)
     src = imagenet.StreamSource(
-        it, sharding, first_step=start_step, depth=d.prefetch_depth,
+        it, sharding, first_step=start_step,
+        depth=datalib.effective_prefetch_depth(config),
         batches_hint=None if train else len(paths) // per_process,
         **imagenet.stream_guard_kwargs(config, train=train))
     src._native_loader = loader  # keep alive; closed on GC

@@ -177,6 +177,7 @@ def make_token_source(config: TrainConfig, sharding, *, start_step: int = 0,
                       objective: str = "mlm") -> StreamSource:
     it = _batch_stream(config, train=train, start_step=start_step,
                        objective=objective)
+    from distributeddeeplearning_tpu import data as datalib
     return StreamSource(it, sharding, first_step=start_step,
-                        depth=config.data.prefetch_depth,
+                        depth=datalib.effective_prefetch_depth(config),
                         **stream_guard_kwargs(config, train=train))

@@ -78,6 +78,34 @@ def bf16_peak_flops(device_kind: str) -> float | None:
     return _by_kind(_BF16_PEAK_BY_KIND, device_kind)
 
 
+# TPU MXUs natively multiply bf16; XLA executes a true-f32 matmul as a
+# 6-pass bf16x6 decomposition (each operand split into three bf16 terms),
+# so the sustainable f32 matmul peak is the bf16 peak / 6 across
+# generations. Published spec sheets quote bf16 only, which is why the
+# ratio is a convention here rather than a per-chip table.
+_F32_PEAK_DIVISOR = 6.0
+
+
+def peak_flops(device_kind: str, dtype: str = "bfloat16") -> float | None:
+    """Per-chip matmul peak for a compute dtype, or None if unknown.
+
+    The dtype-aware roofline denominator (docs/perf_measurement.md): an
+    fp32 arm is scored against the fp32 roof and a mixed/bf16 arm against
+    the bf16 roof, so ``pct_of_peak`` measures distance from what the
+    chip could do AT THAT PRECISION — while raw ``examples_per_sec``
+    still shows the mixed arm's absolute win.
+    """
+    peak = _by_kind(_BF16_PEAK_BY_KIND, device_kind)
+    if peak is None:
+        return None
+    if dtype in ("float32", "f32"):
+        return peak / _F32_PEAK_DIVISOR
+    if dtype in ("bfloat16", "bf16", "float16", "f16"):
+        return peak
+    raise ValueError(f"unknown compute dtype {dtype!r} for peak_flops "
+                     f"(expected float32 or bfloat16)")
+
+
 def hbm_bw_bytes(device_kind: str) -> float | None:
     """Per-chip HBM bandwidth (bytes/s), or None if unknown."""
     return _by_kind(_HBM_BW_BY_KIND, device_kind)

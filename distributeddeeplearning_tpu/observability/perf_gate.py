@@ -135,10 +135,20 @@ PIPELINE_WORKLOAD = {
     "pipeline_schedule": "1f1b",
     "pipeline_virtual_stages": 2,
 }
+# The large-batch mixed-precision proxy (ISSUE 20): the default model at
+# 2x the default batch under the explicit mixed policy (bf16 compute +
+# fp32 master weights + dynamic loss scaling) with LARS — the large-batch
+# recipe's compiled step, including every op the policy adds (loss
+# scale/unscale, the overflow reduction, the skip-select on params and
+# opt state, the scale automaton). A retrace, added sync, or host stall
+# in the mixed path fails tier-1 here instead of waiting for chip time.
+LARGEBATCH_WORKLOAD = dict(WORKLOAD, batch=16, steps=6, dtype="bfloat16",
+                           precision="mixed", optimizer="lars")
 WORKLOADS = {
     "default": WORKLOAD,
     "zero2_overlap": dict(WORKLOAD, steps=6, dp=2,
                           optimizer_sharding="zero2"),
+    "largebatch_bf16": LARGEBATCH_WORKLOAD,
     "pipeline_1f1b": PIPELINE_WORKLOAD,
     "serve_decode": SERVE_WORKLOAD,
     "serve_prefix_prefill": SERVE_PREFIX_WORKLOAD,
@@ -189,7 +199,8 @@ class ProxyRunner:
         self.workload = dict(WORKLOAD, **(workload or {}))
         from distributeddeeplearning_tpu import data as datalib
         from distributeddeeplearning_tpu.config import (
-            DataConfig, ParallelConfig, TrainConfig)
+            DataConfig, OptimizerConfig, ParallelConfig, PrecisionPolicy,
+            TrainConfig)
         from distributeddeeplearning_tpu.models import model_spec
         from distributeddeeplearning_tpu.train import loop
 
@@ -210,10 +221,21 @@ class ProxyRunner:
         else:
             data = DataConfig(synthetic=True, image_size=w["image_size"],
                               num_classes=10)
+        # Optional policy/optimizer keys (the largebatch_bf16 workload):
+        # "precision" arms an explicit PrecisionPolicy, "optimizer" swaps
+        # the update rule (LARS for the large-batch recipe).
+        extra_kw: dict = {}
+        if w.get("precision") == "mixed":
+            extra_kw["precision"] = PrecisionPolicy.mixed()
+        elif w.get("precision") == "fp32":
+            extra_kw["precision"] = PrecisionPolicy.fp32()
+        if w.get("optimizer"):
+            extra_kw["optimizer"] = OptimizerConfig(
+                name=w["optimizer"], schedule="constant")
         self.config = TrainConfig(
             model=w["model"], backend="cpu",
             global_batch_size=w["batch"], dtype=w["dtype"],
-            seed=w["seed"], log_every=10**9,
+            seed=w["seed"], log_every=10**9, **extra_kw,
             optimizer_sharding=w.get("optimizer_sharding", "none"),
             pipeline_schedule=w.get("pipeline_schedule", "gpipe"),
             pipeline_virtual_stages=w.get("pipeline_virtual_stages", 1),

@@ -101,12 +101,15 @@ def backend_identity() -> Optional[dict]:
 
 def roofline(value: Optional[float], model: str, *,
              seq_len: Optional[int] = None, mlm_positions: int = 0,
-             device_kind: Optional[str] = None) -> dict:
+             device_kind: Optional[str] = None,
+             compute_dtype: str = "bfloat16") -> dict:
     """Roofline fields for a rate of ``value`` examples/sec/chip:
     ``tflops_per_sec`` (analytic model FLOPs actually sustained) and
-    ``pct_of_peak`` (vs the chip's bf16 spec peak — the %-of-peak axis the
-    large-batch ResNet papers compare on). Unknown model or chip omits the
-    respective field; never raises."""
+    ``pct_of_peak`` (vs the chip's spec peak AT ``compute_dtype`` — the
+    %-of-peak axis the large-batch ResNet papers compare on; an fp32 arm
+    scores against the fp32 roof, a mixed arm against bf16, so the two
+    arms measure distance from their own speed of light). Unknown model
+    or chip omits the respective field; never raises."""
     out: dict = {}
     if value is None:
         return out
@@ -118,10 +121,15 @@ def roofline(value: Optional[float], model: str, *,
             return out
         out["tflops_per_sec"] = round(value * per_ex / 1e12, 2)
         if device_kind:
-            peak = flopslib.bf16_peak_flops(device_kind)
+            peak = flopslib.peak_flops(device_kind, compute_dtype)
             if peak:
                 out["pct_of_peak"] = round(100.0 * value * per_ex / peak, 1)
-                out["bf16_peak_tflops"] = round(peak / 1e12, 0)
+                out["peak_tflops"] = round(peak / 1e12, 0)
+                out["peak_dtype"] = compute_dtype
+                if compute_dtype == "bfloat16":
+                    # Back-compat alias: pre-policy records carried the
+                    # bf16 roof under this name.
+                    out["bf16_peak_tflops"] = out["peak_tflops"]
     except Exception:
         return {}
     return out
@@ -202,6 +210,19 @@ def annotate(rec: dict, *, provenance: str,
                 config, total_steps=total_steps)
         except Exception:
             pass  # fingerprint is annotation; its absence is visible anyway
+        try:
+            # Precision-policy + batch-ramp provenance: every config-tied
+            # perf record names the policy and ramp it ran under, so an
+            # fp32 and a mixed arm (or a ramped and an unramped run) can
+            # never be conflated — and never share a last-good baseline
+            # entry, since both fields feed the fingerprint above.
+            from distributeddeeplearning_tpu.config import resolve_precision
+            from distributeddeeplearning_tpu.train import optim as optimlib
+            rec.setdefault("precision",
+                           resolve_precision(config).describe())
+            rec.setdefault("batch_ramp", optimlib.ramp_describe(config))
+        except Exception:
+            pass  # annotation only, like the fingerprint
     if provenance != "error":
         schedules = lint_schedules()
         if schedules:

@@ -286,12 +286,18 @@ def _scatter_members(fulls, layout: Zero1Layout, axis_names: AxisNames,
 
 
 def _gather_members(chunks, layout: Zero1Layout, axis_names: AxisNames,
-                    b: int, scope_prefix: str = "zero1") -> tuple:
+                    b: int, scope_prefix: str = "zero1",
+                    out_dtype=None) -> tuple:
     """One bucket's all-gather: chunk member leaves (ordered as
     ``layout.plan.buckets[b]``) -> full-shaped member leaves. The gathered
     ``(N*row,)`` payload reshapes to ``(N, row)`` with row k = shard k's
     chunks; slicing a member's column block and raveling row-major
-    restores its padded flat leaf in natural order."""
+    restores its padded flat leaf in natural order.
+
+    ``out_dtype`` (mixed precision, zero3): cast each chunk to the compute
+    dtype BEFORE the collective — halving the wire bytes when the masters
+    are fp32 and compute is bf16 — and leave the gathered full leaves in
+    that dtype instead of restoring the plan (master) dtypes."""
     members = layout.plan.buckets[b]
     n = layout.axis_size
     tele = telemetry.get()
@@ -300,7 +306,11 @@ def _gather_members(chunks, layout: Zero1Layout, axis_names: AxisNames,
                         bucket=b, leaves=len(members))
     with tele.span(f"collective:{scope}", cat="trace",
                    leaves=len(members)), jax.named_scope(scope):
-        common = jnp.result_type(*(layout.plan.dtypes[i] for i in members))
+        if out_dtype is not None:
+            common = jnp.dtype(out_dtype)
+        else:
+            common = jnp.result_type(
+                *(layout.plan.dtypes[i] for i in members))
         parts = [chunks[j].astype(common) for j in range(len(members))]
         row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         full = jax.lax.all_gather(row, axis_names, tiled=True)
@@ -311,8 +321,10 @@ def _gather_members(chunks, layout: Zero1Layout, axis_names: AxisNames,
             c = layout.chunk_sizes[i]
             shape = layout.plan.shapes[i]
             piece = jax.lax.slice_in_dim(mat, off, off + c, axis=1)
+            leaf_dtype = (out_dtype if out_dtype is not None
+                          else layout.plan.dtypes[i])
             out.append(piece.reshape(n * c)[:_numel(shape)]
-                       .reshape(shape).astype(layout.plan.dtypes[i]))
+                       .reshape(shape).astype(leaf_dtype))
             off += c
     return tuple(out)
 
@@ -338,19 +350,21 @@ def reduce_scatter(tree, layout: Zero1Layout, axis_names: AxisNames, *,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames):
+def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames,
+                      *, out_dtype=None):
     """Reassemble full leaves from per-shard chunks (updated parameters).
 
     One ``all_gather`` per fusion bucket (see :func:`_gather_members`) —
     the second half of the ring all-reduce, moved AFTER the optimizer
-    update.
+    update. ``out_dtype`` casts before the wire and skips the restore to
+    master dtypes (mixed-precision zero3 forward gathers).
     """
     leaves, treedef = jax.tree_util.tree_flatten(chunks)
     _check_leaves(layout, len(leaves))
     out: list[Any] = [None] * len(leaves)
     for b, members in enumerate(layout.plan.buckets):
         pieces = _gather_members([leaves[i] for i in members], layout,
-                                 axis_names, b)
+                                 axis_names, b, out_dtype=out_dtype)
         for i, piece in zip(members, pieces):
             out[i] = piece
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -367,14 +381,17 @@ def all_gather_chunks(chunks, layout: Zero1Layout, axis_names: AxisNames):
 
 @functools.lru_cache(maxsize=None)
 def _gather_vjp(layout: Zero1Layout, axis_names, b: int, payload_dtype,
-                scope_prefix: str):
+                scope_prefix: str, out_dtype=None):
     """ZeRO-3 bucket primitive: fwd all-gathers this shard's chunks into
-    full leaves; bwd reduce-scatters the full-shaped cotangents back to
-    chunk cotangents (the exact transpose of a tiled all-gather whose
-    output feeds every shard's loss term)."""
+    full leaves (in ``out_dtype`` when set — bf16 compute params from fp32
+    masters, cast before the wire); bwd reduce-scatters the full-shaped
+    cotangents back to chunk cotangents in the plan (master) dtypes (the
+    exact transpose of a tiled all-gather whose output feeds every shard's
+    loss term)."""
 
     def _primal(*chunks):
-        return _gather_members(chunks, layout, axis_names, b, scope_prefix)
+        return _gather_members(chunks, layout, axis_names, b, scope_prefix,
+                               out_dtype=out_dtype)
 
     def _fwd(*chunks):
         return _primal(*chunks), None
@@ -424,9 +441,15 @@ def _as_axis_key(axis_names: AxisNames):
     return axis_names if isinstance(axis_names, str) else tuple(axis_names)
 
 
+def _dtype_key(dtype):
+    """Hashable, canonical form of an optional dtype for the lru_cached
+    vjp factories (np scalar types and jnp.dtype objects must alias)."""
+    return None if dtype is None else jnp.dtype(dtype).name
+
+
 def gather_params_overlapped(pchunks, layout: Zero1Layout,
                              axis_names: AxisNames, *, payload_dtype=None,
-                             scope_prefix: str = "zero3"):
+                             scope_prefix: str = "zero3", out_dtype=None):
     """ZeRO-3 on-demand parameter materialization with backward overlap.
 
     Assembles the full parameter tree from this shard's chunk tree, one
@@ -440,7 +463,8 @@ def gather_params_overlapped(pchunks, layout: Zero1Layout,
     out: list[Any] = [None] * len(leaves)
     key = _as_axis_key(axis_names)
     for b, members in enumerate(layout.plan.buckets):
-        fn = _gather_vjp(layout, key, b, payload_dtype, scope_prefix)
+        fn = _gather_vjp(layout, key, b, payload_dtype, scope_prefix,
+                         _dtype_key(out_dtype))
         fulls = fn(*[leaves[i] for i in members])
         for i, full in zip(members, fulls):
             out[i] = full

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from distributeddeeplearning_tpu.config import (TrainConfig,
-                                                resolve_mlm_max_predictions)
+                                                resolve_mlm_max_predictions,
+                                                resolve_precision)
 from distributeddeeplearning_tpu import data as datalib
 from distributeddeeplearning_tpu.data import synthetic
 from distributeddeeplearning_tpu.models import model_spec
@@ -44,7 +45,10 @@ from distributeddeeplearning_tpu.utils.logging import MetricLogger
 
 
 def _dtype(config: TrainConfig):
-    return jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    # The model's compute dtype comes from the precision policy; with no
+    # explicit policy this resolves to config.dtype (legacy behavior).
+    compute = resolve_precision(config).compute_dtype
+    return jnp.bfloat16 if compute == "bfloat16" else jnp.float32
 
 
 def steps_per_epoch(config: TrainConfig) -> Optional[int]:
@@ -326,7 +330,8 @@ def build(config: TrainConfig, total_steps: int):
                 params=params, opt_state=tx.init(opt_params),
                 batch_stats=variables.get("batch_stats"),
                 ema_params=(params if config.optimizer.ema_decay > 0
-                            else None))
+                            else None),
+                loss_scale=steps.init_loss_scale(config))
 
         if sharded:
             abstract = jax.eval_shape(init_fn, rng)
@@ -358,11 +363,62 @@ def build(config: TrainConfig, total_steps: int):
     return mesh, model, batch_shd, state, train_step, sched, rng
 
 
+def _run_ramp(config: TrainConfig, stages, *, total_steps, logger,
+              warmup_steps, eval_batches, return_state,
+              restore_for_eval) -> dict[str, Any]:
+    """Staged global-batch ramp (arXiv 1711.04325 recipe): run each stage
+    as its own segment at the stage batch — the per-stage LR follows for
+    free from the linear-scaling rule, because ``make_optimizer`` scales
+    the base LR by stage_batch / reference_batch when each segment builds.
+
+    Stages chain through the checkpoint dir when one is configured (every
+    boundary lands on the checkpoint cadence by construction, so a stage
+    transition IS an ordinary resume — elastic re-formation and
+    cross-degree resume compose unchanged), or by carrying the final state
+    in process when there is none (quick benches). The returned summary is
+    the final stage's — steady state at the target batch — plus a
+    ``batch_ramp`` block describing the staging."""
+    live = [st for st in stages if st.start_step < total_steps]
+    if not live:
+        live = stages[-1:]
+    carried = None
+    summary: dict[str, Any] = {}
+    stage_meta = []
+    for k, st in enumerate(live):
+        end = total_steps if st.end_step is None else min(st.end_step,
+                                                          total_steps)
+        cfg_s = config.replace(global_batch_size=st.batch)
+        if k > 0 and config.checkpoint_dir:
+            cfg_s = cfg_s.replace(resume=True)
+        last = k == len(live) - 1
+        want_state = (return_state and last) or (
+            not config.checkpoint_dir and not last)
+        summary = run(cfg_s, total_steps=end, logger=logger,
+                      warmup_steps=warmup_steps, eval_batches=eval_batches,
+                      return_state=want_state,
+                      restore_for_eval=restore_for_eval,
+                      _ramp_stage=True, _carried_state=carried)
+        carried = summary.get("state")
+        if not (return_state and last):
+            summary.pop("state", None)
+        stage_meta.append({
+            "batch": int(st.batch),
+            "start_step": int(st.start_step),
+            "end_step": int(end),
+            "examples_per_sec": summary.get("examples_per_sec"),
+        })
+    summary["batch_ramp"] = {"spec": config.batch_ramp,
+                             "stages": stage_meta}
+    return summary
+
+
 def run(config: TrainConfig, *, total_steps: int,
         logger: Optional[MetricLogger] = None,
         warmup_steps: int = 0, eval_batches: int = 0,
         return_state: bool = False,
-        restore_for_eval: bool = False) -> dict[str, Any]:
+        restore_for_eval: bool = False,
+        _ramp_stage: bool = False,
+        _carried_state: Optional[TrainState] = None) -> dict[str, Any]:
     """Train for ``total_steps``; returns a summary with throughput.
 
     ``warmup_steps`` are excluded from timing (compile + first-step cost),
@@ -376,6 +432,21 @@ def run(config: TrainConfig, *, total_steps: int,
     (perplexity) for token models.
     """
     t_origin = time.perf_counter()  # time_to_first_step_s measures from here
+    if not _ramp_stage and not restore_for_eval:
+        # Stage segments re-enter run() with a per-stage batch size that
+        # deliberately differs from the ramp's final batch — only the
+        # top-level call parses (and validates) the schedule.
+        ramp = optim.parse_batch_ramp(
+            getattr(config, "batch_ramp", None),
+            final_batch=config.global_batch_size,
+            checkpoint_every=(config.checkpoint_every_steps
+                              if config.checkpoint_dir else 0))
+        if ramp is not None:
+            return _run_ramp(config, ramp, total_steps=total_steps,
+                             logger=logger, warmup_steps=warmup_steps,
+                             eval_batches=eval_batches,
+                             return_state=return_state,
+                             restore_for_eval=restore_for_eval)
     owns_logger = logger is None
     logger = logger or MetricLogger()
     # A caller-reused logger (in-process restart harnesses) must not turn
@@ -418,8 +489,9 @@ def run(config: TrainConfig, *, total_steps: int,
         _per_ex = flopslib.train_flops_per_example(
             config.model, seq_len=config.data.seq_len,
             mlm_positions=mlm_pred)
-        _peak = flopslib.bf16_peak_flops(
-            jax.devices()[0].device_kind)
+        _peak = flopslib.peak_flops(
+            jax.devices()[0].device_kind,
+            resolve_precision(config).compute_dtype)
         logger.set_roofline(
             _per_ex, _peak * jax.device_count() if _peak else None)
     except Exception:
@@ -433,7 +505,7 @@ def run(config: TrainConfig, *, total_steps: int,
             rng, ckpt, logger, total_steps=total_steps,
             warmup_steps=warmup_steps, eval_batches=eval_batches,
             return_state=return_state, restore_for_eval=restore_for_eval,
-            t_origin=t_origin)
+            t_origin=t_origin, carried_state=_carried_state)
     except BaseException as exc:
         # Fsync'd BEFORE teardown: even if the finally below wedges, the
         # flight record already explains how the run ended (SIGKILL skips
@@ -456,7 +528,7 @@ def run(config: TrainConfig, *, total_steps: int,
 def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                rng, ckpt, logger, *, total_steps, warmup_steps, eval_batches,
                return_state, restore_for_eval=False,
-               t_origin=None) -> dict[str, Any]:
+               t_origin=None, carried_state=None) -> dict[str, Any]:
     if t_origin is None:
         t_origin = time.perf_counter()
     # Fault plan (robustness/faults.py): config.fault_plan + the per-child
@@ -466,6 +538,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     fault_plan = faultslib.resolve(config)
     fault_plan.validate(total_steps, checkpoint_dir=config.checkpoint_dir)
     start_step = 0
+    if carried_state is not None:
+        # In-process batch-ramp chaining (no checkpoint dir): adopt the
+        # previous stage's final state — same mesh, model, and state
+        # structure; only the batch shape and LR scale changed — and pick
+        # the loop position up from its step counter.
+        state = carried_state
+        start_step = int(jax.device_get(state.step))
     resolved_loader = datalib.resolve_loader(config, spec.input_kind)
     live_degree = meshlib.data_parallel_degree(config.parallel)
     # The explicit-DP step carries its stage as an attribute; the GSPMD
@@ -496,7 +575,13 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         # (they feed no optimizer).
         meta = {"loader": resolved_loader, "opt_state_layout": "canonical"}
         if not restore_for_eval:
-            meta["global_batch_size"] = int(config.global_batch_size)
+            # Under a batch ramp the strict key is the ramp's FINAL batch
+            # (constant across every stage segment, and equal to a plain
+            # unramped config's global_batch_size): a mid-ramp stage resume
+            # and an unramped continuation at the target batch both pass,
+            # while resuming at a genuinely different problem still fails
+            # loudly. The ramp spec itself rides in the informational set.
+            meta["global_batch_size"] = int(optim.ramp_final_batch(config))
         # optimizer_sharding / pipeline_degree join mesh_degree as
         # informational (rewritten each run): the canonical layout makes
         # checkpoints interchangeable across ZeRO stages and pipeline
@@ -504,7 +589,8 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
         prior_meta = ckpt.verify_or_record_stream_meta(
             meta, update={"mesh_degree": live_degree,
                           "optimizer_sharding": live_stage,
-                          "pipeline_degree": live_pp})
+                          "pipeline_degree": live_pp,
+                          "batch_ramp": optim.ramp_describe(config)})
     # The membership event of a re-formed elastic attempt (exported by the
     # launcher as DDL_ELASTIC_EVENT): detect_t is CLOCK_MONOTONIC at fault
     # detection, the same clock telemetry.now_s() reads in this process, so
@@ -631,6 +717,10 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             _off = ("+offload" if getattr(config, "opt_state_offload", False)
                     else "")
             ar += f" | opt-sharding: {_stage}{_ov}{_off} ({zl.describe()})"
+        if config.precision is not None:
+            ar += f" | precision: {resolve_precision(config).describe()}"
+        if getattr(config, "batch_ramp", None):
+            ar += f" | batch-ramp: {config.batch_ramp}"
         print(f"# mesh: {meshlib.local_mesh_description(mesh)} | "
               f"model={config.model} global_batch={config.global_batch_size} "
               f"dtype={config.dtype} loader={resolved_loader}" + ar
@@ -752,6 +842,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
     straggler = stragglib.make_monitor(config)
     phase_clock = tele.enabled or straggler is not None
     data_wait_acc = 0.0             # seconds in source.batch since last log
+    data_wait_total = 0.0           # seconds in source.batch, whole run
     t_last_log = telemetry.now_s()  # log-interval origin for straggler math
     steps_at_last_log = start_step
     if heartbeat is not None:
@@ -822,17 +913,22 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             t_step0 = (time.perf_counter() if compile_time_s is None
                        else None)
             if n == 1:
+                # The data-wait clock runs UNCONDITIONALLY (two monotonic
+                # reads per step — noise): data_wait_frac must be present
+                # on every log record even when ~0, so the anomaly
+                # detector's loader-stall dominance test and the input-
+                # pipeline headroom claim read the same always-on signal.
+                t0 = telemetry.now_s()
+                batch = source.batch(i)
+                t1 = telemetry.now_s()
+                data_wait_acc += t1 - t0
                 if phase_clock:
-                    t0 = telemetry.now_s()
-                    batch = source.batch(i)
-                    t1 = telemetry.now_s()
                     tele.record_span("data_wait", t0, t1, step=i)
-                    data_wait_acc += t1 - t0
                     state, metrics = train_step(state, batch, rng)
                     tele.record_span("dispatch", t1, telemetry.now_s(),
                                      step=i)
                 else:
-                    state, metrics = train_step(state, source.batch(i), rng)
+                    state, metrics = train_step(state, batch, rng)
             else:
                 if phase_clock:
                     t1 = telemetry.now_s()
@@ -965,6 +1061,14 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                     extra["time_to_first_step_s"] = round(
                         time_to_first_step_s, 3)
                     compile_pending = None
+                # Always-present loader-stall share of the interval (0.0
+                # when the pipeline kept up — fused on-device blocks fetch
+                # nothing and honestly read 0). The logger mirrors every
+                # numeric field into telemetry gauges, so this lands in
+                # the JSONL record, the gauge stream, and the registry.
+                extra["data_wait_frac"] = round(
+                    data_wait_acc / (t_log - t_last_log), 6) \
+                    if t_log - t_last_log > 1e-9 else 0.0
                 # logger floats every metric (a true fetch barrier); no
                 # separate block needed. Its span is therefore the device
                 # time of the steps still in flight — log-cadence only, so
@@ -996,6 +1100,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
                 if tele.enabled:
                     _record_hbm_gauges(tele, int(i))
                 t_last_log, steps_at_last_log = telemetry.now_s(), i
+                data_wait_total += data_wait_acc
                 data_wait_acc = 0.0
             if done > warmup_steps:
                 # Blocks never straddle the warmup edge (it is a boundary),
@@ -1110,6 +1215,17 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             if parts:
                 print("# memory: " + " ".join(parts),
                       file=sys.stderr, flush=True)
+    # Input-pipeline headroom (docs/perf_measurement.md): whole-run seconds
+    # spent blocked in source.batch, and — when a timed window exists — the
+    # share of that window they represent. ~0 means the loader kept ahead
+    # of the device at this batch size; the large-batch claim ("still ~0
+    # at 2x the batch") reads THIS field off the stamped record.
+    data_wait_total += data_wait_acc
+    summary["input_pipeline"] = {
+        "loader": resolved_loader,
+        "prefetch_depth": int(datalib.effective_prefetch_depth(config)),
+        "data_wait_s": round(data_wait_total, 4),
+    }
     if t_timed is not None and timed_examples:
         elapsed = time.perf_counter() - t_timed
         summary["examples_per_sec"] = timed_examples / elapsed
@@ -1117,6 +1233,12 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             summary["examples_per_sec"] / jax.device_count())
         summary["steps_per_sec"] = (
             total_steps - start_step - warmup_steps) / elapsed
+        if elapsed > 1e-9:
+            # Approximate on purpose: the wait accumulator spans the whole
+            # run while the clock window excludes warmup — headroom is a
+            # capacity signal, not a benchmark metric.
+            summary["input_pipeline"]["data_wait_frac"] = round(
+                min(data_wait_total / elapsed, 1.0), 6)
     # Run summaries emit into the perf_report schema: this summary was
     # measured by THIS process on the backend below — provenance fresh —
     # and carries the roofline %-of-peak (null when model FLOPs or the
@@ -1130,6 +1252,7 @@ def _run_inner(config, spec, mesh, model, batch_shd, state, train_step, sched,
             config.data.mlm_max_predictions, config.data.seq_len,
             spec.objective) if spec.input_kind == "tokens" else 0),
         device_kind=getattr(jax.devices()[0], "device_kind", None),
+        compute_dtype=resolve_precision(config).compute_dtype,
     ).get("pct_of_peak")
     perf_report.annotate(summary, provenance="fresh",
                          config=config, total_steps=total_steps)
@@ -1289,6 +1412,11 @@ def _write_sharding_sidecar(config, train_step, overlap_frac,
             getattr(config, "opt_state_offload", False)),
         "dp": config.parallel.data * config.parallel.fsdp,
         "model": config.model,
+        # Active precision policy + ramp, for tools/doctor.py check_precision
+        # — which policy actually ran, not which one the flags implied.
+        "precision": resolve_precision(config).describe(),
+        "precision_explicit": config.precision is not None,
+        "batch_ramp": optim.ramp_describe(config),
     }
     if config.parallel.pipeline > 1:
         # Pipeline block for tools/doctor.py check_pipeline: what schedule

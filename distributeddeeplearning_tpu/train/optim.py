@@ -25,6 +25,7 @@ exactly (up to fp summation order).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Optional
 
 import flax
@@ -58,6 +59,135 @@ def _decay_mask(params: Any) -> Any:
 def scaled_lr(cfg: OptimizerConfig, global_batch: int) -> float:
     """Linear-scaling rule: lr = base_lr * batch / reference_batch."""
     return cfg.learning_rate * global_batch / cfg.reference_batch
+
+
+# ---------------------------------------------------------------------------
+# Staged global-batch ramp (arXiv 1711.04325: "Extremely Large Minibatch
+# SGD" ramps 8k -> 32k mid-run with the LR following the linear-scaling
+# rule). The ramp is pure host-side orchestration: train/loop.run splits the
+# horizon into stages, each stage a normal run segment at its own
+# global_batch_size (LR scaled per stage by the existing scaled_lr rule)
+# that resumes from the previous stage's checkpoint. Because every boundary
+# is forced onto the checkpoint cadence, elastic re-formation and
+# cross-degree resume inside a stage compose unchanged — a boundary IS a
+# checkpoint/restore, the one transition those paths already handle.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RampStage:
+    """One stage of a staged batch ramp: run ``[start_step, end_step)`` at
+    ``batch`` examples per optimizer step (``end_step=None`` = to the
+    horizon)."""
+
+    batch: int
+    start_step: int
+    end_step: Optional[int]
+
+
+def parse_batch_ramp(spec: Optional[str], *, final_batch: int,
+                     checkpoint_every: int) -> Optional[list[RampStage]]:
+    """Parse a ``batch:steps,...,batch`` ramp spec into stages.
+
+    ``"8192:600,16384:600,32768"`` = 600 steps at 8192, 600 at 16384, then
+    32768 to the horizon. Validation is strict and happens up front — a
+    malformed ramp must die before any backend init:
+
+    - every stage but the last carries an explicit step count; the last
+      must not (it runs to the horizon);
+    - the last stage's batch must equal ``final_batch`` (the config's
+      ``global_batch_size`` — the ramp describes how to REACH it);
+    - batches must be positive and non-decreasing;
+    - every boundary must be a multiple of ``checkpoint_every`` so each
+      stage transition rides an existing checkpoint save/restore.
+
+    Returns None for an absent spec or a degenerate single-stage ramp at
+    the final batch (both mean: no ramp orchestration needed).
+    """
+    if not spec:
+        return None
+    stages: list[RampStage] = []
+    parts = [s.strip() for s in spec.split(",") if s.strip()]
+    if not parts:
+        raise ValueError(f"batch_ramp {spec!r}: empty spec")
+    step = 0
+    for i, part in enumerate(parts):
+        last = i == len(parts) - 1
+        if ":" in part:
+            if last:
+                raise ValueError(
+                    f"batch_ramp {spec!r}: the last stage must not carry a "
+                    f"step count (it runs to the horizon)")
+            b_str, n_str = part.split(":", 1)
+            try:
+                batch, n = int(b_str), int(n_str)
+            except ValueError:
+                raise ValueError(f"batch_ramp {spec!r}: stage {part!r} is "
+                                 f"not 'batch:steps'") from None
+            if n < 1:
+                raise ValueError(f"batch_ramp {spec!r}: stage {part!r} must "
+                                 f"run >= 1 step")
+            stages.append(RampStage(batch=batch, start_step=step,
+                                    end_step=step + n))
+            step += n
+        else:
+            if not last:
+                raise ValueError(
+                    f"batch_ramp {spec!r}: only the last stage may omit "
+                    f":steps (got {part!r} at position {i})")
+            try:
+                batch = int(part)
+            except ValueError:
+                raise ValueError(f"batch_ramp {spec!r}: stage {part!r} is "
+                                 f"not an int batch") from None
+            stages.append(RampStage(batch=batch, start_step=step,
+                                    end_step=None))
+    for st in stages:
+        if st.batch < 1:
+            raise ValueError(f"batch_ramp {spec!r}: batch {st.batch} < 1")
+    for a, b in zip(stages, stages[1:]):
+        if b.batch < a.batch:
+            raise ValueError(
+                f"batch_ramp {spec!r}: batches must be non-decreasing "
+                f"(got {a.batch} -> {b.batch}); a ramp shrinks the step "
+                f"count, never the batch")
+    if stages[-1].batch != final_batch:
+        raise ValueError(
+            f"batch_ramp {spec!r}: final stage batch {stages[-1].batch} != "
+            f"global_batch_size {final_batch} — the ramp describes how to "
+            f"reach the configured batch, not a different one")
+    if checkpoint_every > 0:
+        for st in stages[:-1]:
+            if st.end_step % checkpoint_every:
+                raise ValueError(
+                    f"batch_ramp {spec!r}: boundary at step {st.end_step} "
+                    f"is not a multiple of checkpoint_every_steps="
+                    f"{checkpoint_every} — stage transitions must ride an "
+                    f"existing checkpoint save so resume and elastic "
+                    f"re-formation compose unchanged")
+    if len(stages) == 1:
+        return None  # degenerate: already at the final batch the whole run
+    return stages
+
+
+def ramp_final_batch(config) -> int:
+    """The batch the run ends at: ``global_batch_size`` normally; under a
+    mid-ramp stage segment (where loop.run rewrote global_batch_size to the
+    stage batch) still the ramp's final batch. This is the value the
+    checkpoint stream-meta pins, so every stage of one ramp — and a plain
+    resume at the final batch — agree on it."""
+    spec = getattr(config, "batch_ramp", None)
+    if not spec:
+        return config.global_batch_size
+    last = [s.strip() for s in spec.split(",") if s.strip()][-1]
+    try:
+        return int(last.split(":", 1)[0])
+    except ValueError:
+        return config.global_batch_size
+
+
+def ramp_describe(config) -> str:
+    """Provenance tag for perf records: the ramp spec or ``none``."""
+    return getattr(config, "batch_ramp", None) or "none"
 
 
 def make_schedule(cfg: OptimizerConfig, global_batch: int,

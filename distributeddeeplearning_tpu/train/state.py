@@ -47,11 +47,17 @@ class TrainState:
     batch_stats: Any = None           # BN running stats (CNNs) or None
     ema_params: Any = None            # EMA shadow params (optimizer.ema_decay
                                       # > 0); evals read these when present
+    loss_scale: Any = None            # dynamic loss-scale state
+                                      # ({"scale", "good_steps"}) when the
+                                      # precision policy arms scaling, else
+                                      # None — None keeps the pytree identical
+                                      # to pre-policy checkpoints
 
     @classmethod
     def create(cls, *, params: Any, opt_state: Any,
                batch_stats: Optional[Any] = None,
-               ema_params: Optional[Any] = None) -> "TrainState":
+               ema_params: Optional[Any] = None,
+               loss_scale: Optional[Any] = None) -> "TrainState":
         return cls(step=jnp.zeros((), jnp.int32), params=params,
                    opt_state=opt_state, batch_stats=batch_stats,
-                   ema_params=ema_params)
+                   ema_params=ema_params, loss_scale=loss_scale)

@@ -25,6 +25,7 @@ Both donate the input state (in-place update in HBM, no copy).
 from __future__ import annotations
 
 import collections
+import dataclasses
 import functools
 import time
 from typing import Any, Callable, Optional
@@ -36,7 +37,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributeddeeplearning_tpu import compat
-from distributeddeeplearning_tpu.config import TrainConfig
+from distributeddeeplearning_tpu.config import TrainConfig, resolve_precision
 from distributeddeeplearning_tpu.parallel import collectives
 from distributeddeeplearning_tpu.parallel import sharding as shardlib
 from distributeddeeplearning_tpu.parallel import zero
@@ -120,6 +121,43 @@ def _guard_config(config: TrainConfig):
     nan_steps = faults.resolve(config).nan_grad_steps()
     guard = bool(nan_steps) or bool(getattr(config, "bad_step_guard", False))
     return nan_steps, guard
+
+
+def init_loss_scale(config: TrainConfig):
+    """Initial dynamic-loss-scale state for ``TrainState.loss_scale``:
+    ``{"scale", "good_steps"}`` device scalars when the precision policy
+    arms scaling, None otherwise (the None keeps the state pytree — and
+    therefore every existing checkpoint and sharding-spec derivation —
+    byte-identical for policy-free configs)."""
+    policy = resolve_precision(config)
+    if policy.loss_scale <= 0:
+        return None
+    return {"scale": jnp.float32(policy.loss_scale),
+            "good_steps": jnp.zeros((), jnp.int32)}
+
+
+def _next_loss_scale(policy, scale, good_steps, overflow):
+    """The dynamic-scale automaton, shared by both train-step paths:
+    overflow -> halve (floored at loss_scale_min), ``growth_interval``
+    consecutive good steps -> double (capped at loss_scale_max). Returns
+    (new_state_dict, metrics_dict); the caller applies the update skip."""
+    good = good_steps + jnp.int32(1)
+    grow = good >= jnp.int32(policy.loss_scale_growth_interval)
+    new_scale = jnp.where(
+        overflow,
+        jnp.maximum(scale * jnp.float32(0.5),
+                    jnp.float32(policy.loss_scale_min)),
+        jnp.where(grow,
+                  jnp.minimum(scale * jnp.float32(2.0),
+                              jnp.float32(policy.loss_scale_max)),
+                  scale))
+    new_good = jnp.where(jnp.logical_or(overflow, grow), jnp.int32(0), good)
+    # ``loss_scale_skip`` is deliberately NOT ``bad_step``: a backoff is
+    # the scaler doing its job, and the bad-step anomaly tracker
+    # (train/loop.py) must never count one as a run anomaly.
+    return ({"scale": new_scale, "good_steps": new_good},
+            {"loss_scale": new_scale,
+             "loss_scale_skip": overflow.astype(jnp.float32)})
 
 
 def _ema_update(ema, new_params, decay: float):
@@ -325,6 +363,24 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
     accum = config.grad_accum_steps
 
+    # Precision policy (config.resolve_precision). With no explicit policy
+    # every derived value below collapses to the legacy behavior —
+    # ar_options IS config.allreduce, no loss scaling, fp32 gathers — so
+    # policy-free configs compile the exact seed program (and keep the
+    # zero1<->replicated bitwise pin). An explicit policy re-points the
+    # reduction payload at policy.reduce_dtype and, for bf16 compute,
+    # gathers zero3 params on the wire in bf16 while the persistent chunks
+    # (the masters the optimizer updates) stay fp32.
+    policy = resolve_precision(config)
+    scaling = config.precision is not None and policy.loss_scale > 0
+    ar_options = (dataclasses.replace(config.allreduce,
+                                      dtype=policy.reduce_dtype)
+                  if config.precision is not None else config.allreduce)
+    gather_dtype = (jnp.bfloat16
+                    if (config.precision is not None
+                        and policy.compute_dtype == "bfloat16")
+                    else None)
+
     nan_steps, guard = _guard_config(config)
     stage = getattr(config, "optimizer_sharding", "none") or "none"
     sharded = stage in ("zero1", "zero2", "zero3")
@@ -348,16 +404,29 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 state_like.params)
         if zero_layout is not None:
             layout = zero_layout
-            payload = zero.payload_dtype_from_options(config.allreduce)
+            payload = zero.payload_dtype_from_options(ar_options)
         else:
             layout, payload = zero.layout_from_options(
-                params_struct, dp_size, options=config.allreduce)
+                params_struct, dp_size, options=ar_options)
 
     def step_fn(state: TrainState, batch, rng):
         TRACE_COUNTS["dp_train_step"] += 1  # trace-time only, not per call
         # Per-shard RNG: fold in the linearized DP coordinate.
         idx = jax.lax.axis_index(DATA_AXES)
         rng = jax.random.fold_in(jax.random.fold_in(rng, idx), state.step)
+
+        # Dynamic loss scaling: scale the differentiated scalar only — the
+        # aux metrics (including metrics["loss"]) stay unscaled, and the
+        # gradients come out uniformly multiplied by the scale, which the
+        # unscale below divides back out after the cross-shard reduction.
+        if scaling:
+            ls_scale = state.loss_scale["scale"]
+
+            def lfn(p, bn, b, r):
+                loss, aux = loss_fn(p, bn, b, r)
+                return loss * ls_scale, aux
+        else:
+            lfn = loss_fn
 
         # Per-shard microbatching: the reshape is shard-local (free), and the
         # sum-over-examples gradient is grouping-invariant, so accum-N here
@@ -374,15 +443,17 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             if overlap:
                 def chunk_loss(pc, bn, b, r):
                     full = zero.gather_params_overlapped(
-                        pc, layout, DATA_AXES, payload_dtype=payload)
-                    return loss_fn(full, bn, b, r)
+                        pc, layout, DATA_AXES, payload_dtype=payload,
+                        out_dtype=gather_dtype)
+                    return lfn(full, bn, b, r)
                 gchunks, new_bn, metrics = accumulated_grads(
                     chunk_loss, pchunks, state.batch_stats, batch, rng,
                     accum, vary_axes=DATA_AXES)
             else:
-                full = zero.all_gather_chunks(pchunks, layout, DATA_AXES)
+                full = zero.all_gather_chunks(pchunks, layout, DATA_AXES,
+                                              out_dtype=gather_dtype)
                 grads, new_bn, metrics = accumulated_grads(
-                    loss_fn, full, state.batch_stats, batch, rng, accum,
+                    lfn, full, state.batch_stats, batch, rng, accum,
                     vary_axes=DATA_AXES)
         elif stage == "zero2" and overlap:
             pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
@@ -394,14 +465,14 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 full = zero.assemble_params_overlapped(
                     state.params, pc, layout, DATA_AXES,
                     payload_dtype=payload)
-                return loss_fn(full, bn, b, r)
+                return lfn(full, bn, b, r)
 
             gchunks, new_bn, metrics = accumulated_grads(
                 chunk_loss, pchunks, state.batch_stats, batch, rng, accum,
                 vary_axes=DATA_AXES)
         else:
             grads, new_bn, metrics = accumulated_grads(
-                loss_fn, state.params, state.batch_stats, batch, rng, accum,
+                lfn, state.params, state.batch_stats, batch, rng, accum,
                 vary_axes=DATA_AXES)
 
         if nan_steps:
@@ -428,6 +499,14 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 gchunks = zero.reduce_scatter(grads, layout, DATA_AXES,
                                               payload_dtype=payload)
             gchunks = jax.tree_util.tree_map(lambda g: g / dp_size, gchunks)
+            if scaling:
+                # Overflow check on the still-scaled chunks, then unscale.
+                # Each shard holds 1/N of every leaf, so the squared norm
+                # needs one psum to make the verdict shard-consistent.
+                overflow = ~jnp.isfinite(
+                    jax.lax.psum(_tree_sq_norm(gchunks), DATA_AXES))
+                gchunks = jax.tree_util.tree_map(
+                    lambda g: g / ls_scale, gchunks)
             if pchunks is None:
                 pchunks = zero.local_chunks(state.params, layout, DATA_AXES)
             updates, new_opt = tx.update(gchunks, state.opt_state, pchunks)
@@ -450,13 +529,33 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # gradient *average* hvd applies.
             grads = collectives.all_reduce_gradients(
                 grads, DATA_AXES, axis_size=dp_size,
-                options=config.allreduce)
+                options=ar_options)
             grads = jax.tree_util.tree_map(lambda g: g / dp_size, grads)
+            if scaling:
+                # Post-all-reduce gradients are shard-identical, so the
+                # overflow verdict is shard-consistent without a collective.
+                overflow = ~jnp.isfinite(_tree_sq_norm(grads))
+                grads = jax.tree_util.tree_map(lambda g: g / ls_scale, grads)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
 
         new_ema = _ema_update(state.ema_params, new_params,
                               config.optimizer.ema_decay)
+        new_ls = state.loss_scale
+        if scaling:
+            # Loss-scale skip-on-overflow: same select machinery as the
+            # bad-step guard but applied FIRST and accounted separately
+            # (``loss_scale_skip``, never ``bad_step``) — a scale backoff is
+            # normal mixed-precision operation, not a run anomaly, and the
+            # guard below must see the already-restored (finite) state so a
+            # backoff can never double-count.
+            new_params = _skip_if_bad(overflow, new_params, state.params)
+            new_opt = _skip_if_bad(overflow, new_opt, state.opt_state)
+            new_bn = _skip_if_bad(overflow, new_bn, state.batch_stats)
+            new_ema = _skip_if_bad(overflow, new_ema, state.ema_params)
+            new_ls, ls_metrics = _next_loss_scale(
+                policy, ls_scale, state.loss_scale["good_steps"], overflow)
+            metrics.update(ls_metrics)
         if guard:
             # Bad-step guard (docs/fault_tolerance.md). The decision must be
             # identical on every shard, so derive it ONLY from values that
@@ -472,6 +571,11 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 sq = jax.lax.psum(sq, DATA_AXES)
             bad = jnp.logical_or(~jnp.isfinite(metrics["loss"]),
                                  ~jnp.isfinite(sq))
+            if scaling:
+                # An overflow step already skipped above; even if its loss
+                # was non-finite, the scaler owns it — not the anomaly
+                # budget.
+                bad = jnp.logical_and(bad, jnp.logical_not(overflow))
             # Skip-on-bad: the step index still advances (the batch is
             # consumed; a skip is a skip, not a retry), but params/opt/BN/
             # EMA keep their pre-update values so one poisoned batch can't
@@ -483,7 +587,7 @@ def make_dp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             metrics["bad_step"] = bad.astype(jnp.float32)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, batch_stats=new_bn,
-                               ema_params=new_ema)
+                               ema_params=new_ema, loss_scale=new_ls)
         return new_state, metrics
 
     batch_spec = P(DATA_AXES)
@@ -724,7 +828,8 @@ def init_sharded_state(model, tx, mesh: Mesh, config: TrainConfig,
             params=params, opt_state=opt_state,
             batch_stats=variables.get("batch_stats"),
             ema_params=(params if config.optimizer.ema_decay > 0
-                        else None))
+                        else None),
+            loss_scale=init_loss_scale(config))
 
     with use_mesh(mesh):  # model may embed mesh-dependent shard_maps (ring)
         abstract = jax.eval_shape(init_fn, rng)
@@ -751,6 +856,8 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
                           objective: str = "mlm", aot=None):
     loss_fn = loss_fn_for(model, input_kind, config, objective)
     nan_steps, bad_guard = _guard_config(config)
+    policy = resolve_precision(config)
+    scaling = config.precision is not None and policy.loss_scale > 0
     # Token batches are (B, S): dim 0 over the DP axes, dim 1 over `seq`.
     seq_dim = 1 if input_kind == "tokens" else None
     batch_shd = shardlib.batch_sharding(mesh, seq_dim=seq_dim)
@@ -758,6 +865,14 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
     def step_fn(state: TrainState, batch, rng):
         TRACE_COUNTS["gspmd_train_step"] += 1
         rng = jax.random.fold_in(rng, state.step)
+        if scaling:
+            ls_scale = state.loss_scale["scale"]
+
+            def lfn(p, bn, b, r):
+                loss, aux = loss_fn(p, bn, b, r)
+                return loss * ls_scale, aux
+        else:
+            lfn = loss_fn
         with _unreplicated_rules_ctx(config):
             # Microbatching under GSPMD: the (B,) -> (A, B/A) reshape crosses
             # the dp sharding, so XLA may insert a small resharding collective
@@ -770,14 +885,29 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
             # group-normalized losses; the pipeline conveyor hit the same
             # pattern and moved to a strided split (models/pipeline.py).
             grads, new_bn, metrics = accumulated_grads(
-                loss_fn, state.params, state.batch_stats, batch, rng,
+                lfn, state.params, state.batch_stats, batch, rng,
                 config.grad_accum_steps)
         if nan_steps:
             grads = _inject_nan_grads(grads, state.step, nan_steps)
+        if scaling:
+            # One logical program: XLA inserts whatever cross-shard
+            # reduction the norm needs, so the verdict is globally
+            # consistent without an explicit psum.
+            overflow = ~jnp.isfinite(_tree_sq_norm(grads))
+            grads = jax.tree_util.tree_map(lambda g: g / ls_scale, grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_ema = _ema_update(state.ema_params, new_params,
                               config.optimizer.ema_decay)
+        new_ls = state.loss_scale
+        if scaling:
+            new_params = _skip_if_bad(overflow, new_params, state.params)
+            new_opt = _skip_if_bad(overflow, new_opt, state.opt_state)
+            new_bn = _skip_if_bad(overflow, new_bn, state.batch_stats)
+            new_ema = _skip_if_bad(overflow, new_ema, state.ema_params)
+            new_ls, ls_metrics = _next_loss_scale(
+                policy, ls_scale, state.loss_scale["good_steps"], overflow)
+            metrics.update(ls_metrics)
         if bad_guard:
             # Bad-step guard on the post-update params (same placement as
             # the DP path). One logical program: XLA inserts any cross-shard
@@ -785,6 +915,8 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
             # consistent without an explicit psum.
             bad = jnp.logical_or(~jnp.isfinite(metrics["loss"]),
                                  ~jnp.isfinite(_tree_sq_norm(new_params)))
+            if scaling:
+                bad = jnp.logical_and(bad, jnp.logical_not(overflow))
             new_params = _skip_if_bad(bad, new_params, state.params)
             new_opt = _skip_if_bad(bad, new_opt, state.opt_state)
             new_bn = _skip_if_bad(bad, new_bn, state.batch_stats)
@@ -792,7 +924,7 @@ def make_gspmd_train_step(model, tx, mesh: Mesh, config: TrainConfig,
             metrics["bad_step"] = bad.astype(jnp.float32)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, batch_stats=new_bn,
-                               ema_params=new_ema)
+                               ema_params=new_ema, loss_scale=new_ls)
         return new_state, metrics
 
     batch_shardings = functools.partial(_batch_leaf_shardings, mesh, batch_shd)

@@ -96,9 +96,11 @@ def test_suite_rows_reset_flags_and_filter(bench, monkeypatch, capsys):
     # SUITE's value-per-minute order: resnet50 + the two allreduce A/B
     # rows + the three zero-ladder rows (all resnet50), bert flash,
     # (gpt2 filtered out), bert dense, (resnet152 filtered),
-    # densenet121, (vit filtered), bert 2048.
+    # densenet121, (vit filtered), bert 2048, then the two large-batch
+    # precision A/B rows (resnet50 again; pp rows filtered).
     assert models == ["resnet50"] * 6 + ["bert_base", "bert_base",
-                                         "densenet121", "bert_base"]
+                                         "densenet121", "bert_base",
+                                         "resnet50", "resnet50"]
     # Suite rows must NOT inherit headline flags; row overrides apply.
     assert all(s[3] is False for s in seen[:3])  # remat reset
     out = [json.loads(line) for line in
@@ -317,6 +319,7 @@ def test_suite_order_contract_for_chip_window(bench):
         "resnet50", "ar_fused", "ar_perleaf", "zero1", "zero2", "zero3",
         "bert512_flash", "gpt2_1024", "bert512", "resnet152",
         "densenet121", "vit_b16", "bert2048_flash",
+        "largebatch_fp32", "largebatch_bf16",
         "pp_gpipe", "pp_1f1b",
     ]
     key = {n: (m, o.get("attention_impl"), o.get("seq_len"),

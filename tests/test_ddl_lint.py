@@ -658,3 +658,74 @@ def test_lint_cow_recorded_clean():
     """)
     assert lints.analyze_source(src, "cow_ok.py",
                                 mesh_axes=MESH_AXES) == []
+
+
+# ---------------------------------------------------------------------------
+# Seeded corpus: master-weight-cast (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_lint_master_weight_cast_astype_fires():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def shrink(state):
+            return state.opt_state.astype(jnp.bfloat16)
+    """)
+    findings = lints.analyze_source(src, "cast.py", mesh_axes=MESH_AXES)
+    assert "master-weight-cast" in _rules(findings), findings
+
+
+def test_lint_master_weight_cast_constructor_fires():
+    """A dtype=-carrying array constructor retypes its argument just as
+    silently as astype."""
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def shrink(master_params):
+            return jnp.asarray(master_params, dtype="float16")
+    """)
+    findings = lints.analyze_source(src, "ctor.py", mesh_axes=MESH_AXES)
+    assert "master-weight-cast" in _rules(findings), findings
+
+
+def test_lint_master_weight_cast_fp32_and_params_clean():
+    """fp32 casts of masters, and sub-fp32 casts of NON-master values
+    (activations, gathered params on the wire), are both fine."""
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def keep(state, chunks):
+            a = state.opt_state.astype(jnp.float32)
+            b = chunks.astype(jnp.bfloat16)
+            return a, b
+    """)
+    assert lints.analyze_source(src, "clean.py",
+                                mesh_axes=MESH_AXES) == []
+
+
+def test_lint_master_weight_cast_sanctioned_helper_clean():
+    """parallel/zero.py's gather helpers legitimately cast to the wire
+    dtype; their bodies are exempt by name."""
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def _gather_members(opt_state_chunks, out_dtype):
+            return opt_state_chunks.astype(jnp.bfloat16)
+    """)
+    assert lints.analyze_source(src, "sanctioned.py",
+                                mesh_axes=MESH_AXES) == []
+
+
+def test_lint_master_weight_cast_repo_clean():
+    """The rule must hold on the real precision-policy code: steps.py and
+    zero.py cast activations/gathered params, never masters."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("distributeddeeplearning_tpu/train/steps.py",
+                "distributeddeeplearning_tpu/parallel/zero.py",
+                "distributeddeeplearning_tpu/train/optim.py"):
+        with open(os.path.join(root, rel)) as fh:
+            findings = [f for f in lints.analyze_source(
+                fh.read(), rel, mesh_axes=MESH_AXES)
+                if f["rule"] == "master-weight-cast"]
+        assert findings == [], (rel, findings)

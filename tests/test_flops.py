@@ -62,3 +62,16 @@ def test_bf16_peak_table():
     assert flopslib.bf16_peak_flops("TPU v4") == 275e12
     assert flopslib.bf16_peak_flops("TPU v6e") == 918e12
     assert flopslib.bf16_peak_flops("cpu") is None
+
+
+@pytest.mark.core
+def test_dtype_aware_peak():
+    """peak_flops scores each precision arm against its OWN roof: fp32
+    peak is the bf16 peak / 6 (the MXU rate ratio on v4/v5), unknown
+    chips stay None, unknown dtypes die loudly (ISSUE 20)."""
+    assert flopslib.peak_flops("TPU v4", "bfloat16") == 275e12
+    assert flopslib.peak_flops("TPU v4", "float32") == 275e12 / 6.0
+    assert flopslib.peak_flops("TPU v5p", "f32") == 459e12 / 6.0
+    assert flopslib.peak_flops("cpu", "float32") is None
+    with pytest.raises(ValueError, match="unknown compute dtype"):
+        flopslib.peak_flops("TPU v4", "int8")

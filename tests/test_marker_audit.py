@@ -212,3 +212,60 @@ def test_cli_expect_elastic_flag(tmp_path):
     assert subprocess.run(
         cmd + [str(full), "--expect-perf-gate", "--expect-elastic"],
     ).returncode == 0
+
+
+# --- large-batch recipe audit (ISSUE 20 satellite) --------------------------
+
+from tools.marker_audit import audit_largebatch  # noqa: E402
+
+
+def test_audit_largebatch_clean_run():
+    records = [
+        {**_rec("t::test_perf_gate_live_largebatch_bf16", 5.0),
+         "perf_gate": True},
+        _rec("t::test_loss_scale_overflow_skips_and_halves", 3.0),
+        _rec("t::test_ramp_boundary_resume_bitwise", 8.0),
+    ]
+    assert audit_largebatch(records) == []
+
+
+def test_audit_largebatch_flags_all_missing():
+    problems = audit_largebatch([_rec("t::fast", 1.0)])
+    assert len(problems) == 3
+    assert any("largebatch_bf16" in p for p in problems)
+    assert any("loss-scale" in p for p in problems)
+    assert any("batch-ramp" in p for p in problems)
+
+
+def test_audit_largebatch_gate_must_be_perf_gate_marked():
+    """A largebatch-named test WITHOUT the perf_gate marker does not count
+    as the gate — the workload check keys on the marker, not the name."""
+    records = [
+        _rec("t::test_largebatch_helper", 1.0),
+        _rec("t::test_loss_scale_x", 1.0),
+        _rec("t::test_ramp_y", 1.0),
+    ]
+    problems = audit_largebatch(records)
+    assert len(problems) == 1
+    assert "largebatch_bf16" in problems[0]
+
+
+def test_cli_expect_largebatch_flag(tmp_path):
+    cmd = [sys.executable, "tools/marker_audit.py"]
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps([_rec("t::fast", 1.0)]))
+    # Opt-in: partial runs stay quiet...
+    assert subprocess.run(cmd + [str(partial)]).returncode == 0
+    # ...the tier-1 chain opts in and fails loudly.
+    proc = subprocess.run(cmd + [str(partial), "--expect-largebatch"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "largebatch_bf16" in proc.stdout
+    full = tmp_path / "full.json"
+    full.write_text(json.dumps(
+        [{**_rec("t::test_perf_gate_live_largebatch_bf16", 5.0),
+          "perf_gate": True},
+         _rec("t::test_loss_scale_overflow", 2.0),
+         _rec("t::test_ramp_boundary_resume", 2.0)]))
+    assert subprocess.run(
+        cmd + [str(full), "--expect-largebatch"]).returncode == 0

@@ -381,3 +381,56 @@ def test_serve_prefix_prefill_workload_is_registered():
     # near-empty tree walk instead of real page mapping.
     assert w["shared_prefix_len"] >= 2 * w["page_size"]
     assert perf_gate.load_baseline(name="serve_prefix_prefill") is not None
+
+
+# --- the largebatch_bf16 extras workload ------------------------------------
+
+@pytest.fixture(scope="module")
+def runner_largebatch():
+    """ONE compiled largebatch_bf16 proxy (2x-batch mixed-precision LARS
+    step: scale/unscale, overflow reduction, skip-select, scale
+    automaton) shared by the large-batch gate tests."""
+    return perf_gate.ProxyRunner(perf_gate.WORKLOADS["largebatch_bf16"])
+
+
+@pytest.mark.perf_gate
+def test_perf_gate_live_largebatch_bf16(runner_largebatch, monkeypatch,
+                                        tmp_path):
+    """The large-batch mixed-precision gate (ISSUE 20): the policy-armed
+    step must sit inside its extras baseline band — a retrace, added
+    sync, or host stall in the mixed path fails tier-1 here instead of
+    waiting for chip time. Recalibrate with
+    `python tools/perf_gate.py --recalibrate --workload largebatch_bf16`."""
+    monkeypatch.setattr(perf_gate, "LAST_RESULT_PATH",
+                        str(tmp_path / "last.json"))
+    result = perf_gate.check(runner=runner_largebatch,
+                             workload="largebatch_bf16")
+    assert result["ok"], "\n".join(result["violations"])
+    assert result["workload_name"] == "largebatch_bf16"
+    assert result["current"]["workload"]["precision"] == "mixed"
+    # An extras-workload check never overwrites the headline sidecar.
+    assert not (tmp_path / "last.json").exists()
+
+
+@pytest.mark.perf_gate
+def test_largebatch_gate_flips_on_injected_stall(runner_largebatch):
+    """The armed-gate self-test for the large-batch workload: a
+    deliberate stall inside the traced data_wait phase must trip step
+    time out of band AND the data_wait phase share — the zero-data-wait
+    headroom this PR's input pipeline exists to protect."""
+    baseline = perf_gate.load_baseline(name="largebatch_bf16")
+    slow = runner_largebatch.measure(inject_sleep_s=0.25)
+    violations = perf_gate.compare(baseline, slow)
+    assert any("step-time regression" in v for v in violations), violations
+    assert any("phase-mix regression" in v and "data_wait" in v
+               for v in violations), violations
+
+
+def test_largebatch_workload_is_registered():
+    """Losing the WORKLOADS entry (or its extras baseline) silently
+    removes the large-batch gate from tools/perf_gate.py."""
+    w = perf_gate.WORKLOADS["largebatch_bf16"]
+    assert w["precision"] == "mixed"
+    assert w["optimizer"] == "lars"
+    assert w["batch"] == 2 * perf_gate.WORKLOAD["batch"]
+    assert perf_gate.load_baseline(name="largebatch_bf16") is not None

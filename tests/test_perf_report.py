@@ -192,3 +192,20 @@ def test_git_rev_reads_head():
     assert rev and len(rev) == 12
     assert all(c in "0123456789abcdef" for c in rev)
     assert perf_report.git_rev("/no/such/root") is None
+
+
+def test_roofline_scores_against_own_dtype_roof():
+    """The large-batch A/B contract (ISSUE 20): at EQUAL throughput the
+    fp32 arm scores 6x the mixed arm's pct_of_peak (its roof is 6x
+    lower) — so a mixed arm only wins the %-of-peak comparison by
+    actually being faster, and peak_dtype stamps which roof was used."""
+    mixed = perf_report.roofline(2366.0, "resnet50", device_kind="TPU v5e",
+                                 compute_dtype="bfloat16")
+    fp32 = perf_report.roofline(2366.0, "resnet50", device_kind="TPU v5e",
+                                compute_dtype="float32")
+    assert fp32["peak_dtype"] == "float32"
+    assert mixed["peak_dtype"] == "bfloat16"
+    assert fp32["pct_of_peak"] == pytest.approx(
+        6.0 * mixed["pct_of_peak"], rel=0.01)
+    # The bf16 arm keeps the back-compat alias next to the new fields.
+    assert mixed["bf16_peak_tflops"] == mixed["peak_tflops"]

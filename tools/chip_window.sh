@@ -197,6 +197,24 @@ timeout 700 python bench.py --suite --budget 660 \
   > "$RES/bench_zero_ladder.json" 2>> "$RES/log.txt"
 note zero_ladder
 
+# 6c2. Large-batch mixed-precision A/B (gated, ask with DDL_LARGEBATCH=1):
+# the ISSUE 20 acceptance pair — resnet50 at 2x the acceptance batch, fp32
+# recipe vs the full mixed recipe (bf16 compute/reduce, fp32 masters,
+# dynamic loss scaling, LARS). The arms emit under SEPARATED metric names
+# (resnet50_fp32_... / resnet50_mixed_...) with pct_of_peak scored against
+# each arm's OWN dtype roof (fp32 peak = bf16 peak / 6 on v4/v5), so the
+# mixed arm must land a strictly higher %-of-peak for the recipe to count
+# (docs/mixed_precision.md). Gated because b1024 compiles fresh programs
+# for both arms and neither is a last-good acceptance row. ~2 x 90 s +
+# compile.
+if [ "${DDL_LARGEBATCH:-0}" = "1" ]; then
+  check_stop largebatch_ab
+  timeout 480 python bench.py --suite --budget 440 \
+    --suite-rows largebatch_fp32,largebatch_bf16 \
+    > "$RES/bench_largebatch_ab.json" 2>> "$RES/log.txt"
+  note largebatch_ab
+fi
+
 # 6d. Pipeline-schedule A/B (gated, ask with DDL_PIPELINE=1): gpipe vs
 # interleaved 1f1b suite rows at IDENTICAL geometry (pp=2, M=4, V=2 — the
 # only delta is the schedule). Each record carries the measured

@@ -266,6 +266,30 @@ def check_pipeline() -> None:
                   "the first pipelined (--pp > 1) train run")
 
 
+def check_precision() -> None:
+    """Precision policy of the LAST run (the same
+    .cache/last_run_sharding.json sidecar carries ``precision`` /
+    ``precision_explicit`` / ``batch_ramp``): the resolved
+    compute/param/reduce-dtype triple with any dynamic loss scale
+    (e.g. ``bf16/f32/bf16+dls32768``), whether it came from an explicit
+    PrecisionPolicy or the legacy --dtype flag, and the batch-ramp
+    schedule if one ran — so "did that run actually train mixed?" is
+    answerable from doctor output (ISSUE 20). ok=True always: an absent
+    sidecar just means no run has happened yet."""
+    from distributeddeeplearning_tpu.observability import sidecars
+    side = sidecars.read("last_run_sharding")
+    if isinstance(side, dict) and side.get("precision") is not None:
+        emit("precision", ok=True,
+             **{k: side.get(k) for k in (
+                 "precision", "precision_explicit", "batch_ramp",
+                 "model")})
+    else:
+        emit("precision", ok=True, last_run=None,
+             note="no precision field in the sharding sidecar; written "
+                  "by the first train run after the PrecisionPolicy "
+                  "change")
+
+
 def check_elastic() -> None:
     """Last elastic re-formation (loop.py drops
     .cache/last_elastic_event.json on process 0 when a run resumes under a
@@ -426,6 +450,7 @@ def main(argv=None) -> int:
     check_perf_gate()
     check_sharding()
     check_pipeline()
+    check_precision()
     check_elastic()
     check_flight()
     check_ddl_lint()

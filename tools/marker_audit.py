@@ -302,6 +302,39 @@ def audit_pipeline(records) -> list[str]:
     return problems
 
 
+def audit_largebatch(records) -> list[str]:
+    """Problems with large-batch / mixed-precision coverage in this run.
+
+    The large-batch recipe (ISSUE 20: mixed-precision PrecisionPolicy,
+    dynamic loss scaling, batch ramp) is gated by the largebatch_bf16
+    CPU-proxy workload in tests/test_perf_gate.py — losing that test
+    quietly un-gates the mixed-precision step's cost and phase mix. The
+    loss-scale skip path and the ramp-boundary resume pin must also have
+    run, or the recipe regresses to "configured but unproven"."""
+    problems = []
+    if not any(r.get("perf_gate") and "largebatch" in (r.get("nodeid")
+                                                       or "")
+               for r in records):
+        problems.append(
+            "no perf_gate test covering the largebatch_bf16 workload ran "
+            "— the mixed-precision large-batch step is ungated "
+            "(tests/test_perf_gate.py::"
+            "test_perf_gate_live_largebatch_bf16 missing, renamed, or "
+            "deselected?)")
+    if not any("loss_scale" in (r.get("nodeid") or "") for r in records):
+        problems.append(
+            "no loss-scale test ran — the overflow->skip->halve->recover "
+            "automaton is unpinned in this run "
+            "(tests/test_mixed_precision.py missing, renamed, or "
+            "deselected?)")
+    if not any("ramp" in (r.get("nodeid") or "") for r in records):
+        problems.append(
+            "no batch-ramp test ran — ramp-boundary resume identity is "
+            "unpinned in this run (tests/test_mixed_precision.py ramp "
+            "tests missing, renamed, or deselected?)")
+    return problems
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -310,7 +343,7 @@ def main(argv=None) -> int:
               f"{DEFAULT_THRESHOLD_S:g}] [--expect-perf-gate] "
               f"[--expect-elastic] [--expect-flight] [--expect-lint] "
               f"[--expect-serve] [--expect-serve-chaos] "
-              f"[--expect-pipeline]")
+              f"[--expect-pipeline] [--expect-largebatch]")
         return 0 if argv else 2
     expect_gate = "--expect-perf-gate" in argv
     expect_elastic = "--expect-elastic" in argv
@@ -319,11 +352,12 @@ def main(argv=None) -> int:
     expect_serve = "--expect-serve" in argv
     expect_serve_chaos = "--expect-serve-chaos" in argv
     expect_pipeline = "--expect-pipeline" in argv
+    expect_largebatch = "--expect-largebatch" in argv
     argv = [a for a in argv
             if a not in ("--expect-perf-gate", "--expect-elastic",
                          "--expect-flight", "--expect-lint",
                          "--expect-serve", "--expect-serve-chaos",
-                         "--expect-pipeline")]
+                         "--expect-pipeline", "--expect-largebatch")]
     threshold = float(argv[1]) if len(argv) > 1 else DEFAULT_THRESHOLD_S
     try:
         with open(argv[0]) as f:
@@ -362,6 +396,10 @@ def main(argv=None) -> int:
     # pipeline_1f1b gate workload).
     if expect_pipeline:
         gate_problems += audit_pipeline(records)
+    # Large-batch recipe coverage likewise (gate workload + loss-scale
+    # + ramp pins).
+    if expect_largebatch:
+        gate_problems += audit_largebatch(records)
     if not violations and not gate_problems:
         print(f"marker-audit: OK — {len(records)} tests, none over "
               f"{threshold:g}s unmarked")

@@ -151,6 +151,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--optimizer", default=None, choices=["sgd", "lars", "adamw", "lamb"])
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
+    p.add_argument("--precision", default=None, choices=["fp32", "mixed"],
+                   help="explicit precision policy: 'mixed' = bf16 compute + "
+                        "fp32 master weights + dynamic loss scaling, 'fp32' = "
+                        "everything float32; subsumes --dtype "
+                        "(docs/mixed_precision.md)")
+    p.add_argument("--batch-ramp", default=None, metavar="SPEC",
+                   help="staged global-batch ramp, e.g. '8192:600,16384:600,"
+                        "32768' — 600 steps at 8192, 600 at 16384, then the "
+                        "configured batch; every boundary must land on the "
+                        "checkpoint cadence and the last stage must equal "
+                        "--batch-size (docs/mixed_precision.md)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--log-every", type=int, default=None)
     p.add_argument("--warmup-steps", type=int, default=2,
@@ -244,6 +255,12 @@ def build_config(args: argparse.Namespace):
         cfg = cfg.replace(num_epochs=args.epochs)
     if args.dtype:
         cfg = cfg.replace(dtype=args.dtype)
+    if args.precision:
+        pol = (cfglib.PrecisionPolicy.mixed() if args.precision == "mixed"
+               else cfglib.PrecisionPolicy.fp32())
+        cfg = cfg.replace(precision=pol, dtype=pol.compute_dtype)
+    if args.batch_ramp:
+        cfg = cfg.replace(batch_ramp=args.batch_ramp)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
     if args.log_every:
